@@ -1,0 +1,62 @@
+"""Config/CLI tests — reference CommandlineParser semantics
+(main.cpp:459-501) and the run.sh flag set."""
+
+import pytest
+
+from cup2d_tpu.config import CommandlineParser, LineParser, MissingKeyError, SimConfig
+
+RUN_SH_ARGV = (
+    "-AdaptSteps 20 -bpdx 2 -bpdy 1 -CFL 0.5 -Ctol 1 -extent 4 "
+    "-lambda 1e7 -levelMax 8 -levelStart 5 -maxPoissonIterations 1000 "
+    "-maxPoissonRestarts 0 -nu 0.00004 -poissonTol 1e-3 -poissonTolRel 1e-2 "
+    "-Rtol 2 -tdump 0.5 -tend 10.0"
+).split() + [
+    "-shapes",
+    "angle=0 L=0.2 xpos=1.8 ypos=0.8\nangle=180 L=0.2 xpos=1.6 ypos=0.8",
+]
+
+
+def test_basic_parsing():
+    p = CommandlineParser(["-nu", "0.01", "-bpdx", "4", "-flag"])
+    assert p("nu").asDouble() == 0.01
+    assert p("bpdx").asInt() == 4
+    assert p("flag").asString() == "true"
+
+
+def test_negative_numbers_are_values():
+    p = CommandlineParser(["-xvel", "-0.3", "-n", "-5"])
+    assert p("xvel").asDouble() == -0.3
+    assert p("n").asInt() == -5
+
+
+def test_missing_key_aborts():
+    p = CommandlineParser(["-nu", "0.01"])
+    with pytest.raises(MissingKeyError):
+        p("bpdx")
+
+
+def test_plus_override():
+    # first occurrence wins, unless +key forces override (main.cpp:484-490)
+    p = CommandlineParser(["-nu", "1", "-nu", "2"])
+    assert p("nu").asDouble() == 1
+    p = CommandlineParser(["-nu", "1", "-+nu", "2"])
+    assert p("nu").asDouble() == 2
+
+
+def test_run_sh_case():
+    cfg = SimConfig.from_argv(RUN_SH_ARGV)
+    assert cfg.bpdx == 2 and cfg.bpdy == 1
+    assert cfg.level_max == 8 and cfg.level_start == 5
+    assert cfg.h0 == pytest.approx(4.0 / 2 / 8)
+    assert cfg.extents[0] == pytest.approx(4.0)
+    assert cfg.extents[1] == pytest.approx(2.0)
+    assert cfg.min_h == pytest.approx(cfg.h0 / 128)
+    shapes = cfg.parse_shapes()
+    assert len(shapes) == 2
+    assert shapes[0]["xpos"] == 1.8 and shapes[1]["angle"] == 180
+
+
+def test_line_parser():
+    p = LineParser("angle=0 L=0.2 xpos=1.8 ypos=0.8")
+    assert p("L").asDouble() == 0.2
+    assert not p.has("T")

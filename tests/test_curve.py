@@ -1,0 +1,81 @@
+"""Hilbert SFC tests — the oracle role `tool/curve.cpp` plays for the
+reference (forward/inverse identity, curve continuity, encode ordering)."""
+
+import numpy as np
+import pytest
+
+from cup2d_tpu.curve import SpaceCurve, _xy2d, _d2xy
+
+
+@pytest.mark.parametrize("order", [0, 1, 2, 3, 5])
+def test_xy2d_roundtrip(order):
+    n = 1 << order
+    ii, jj = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    d = _xy2d(order, ii.ravel(), jj.ravel())
+    # bijective onto [0, n^2)
+    assert sorted(d.tolist()) == list(range(n * n))
+    x, y = _d2xy(order, d)
+    np.testing.assert_array_equal(x, ii.ravel())
+    np.testing.assert_array_equal(y, jj.ravel())
+
+
+def test_hilbert_continuity():
+    """Consecutive curve indices are grid neighbors (locality — the property
+    load balancing relies on)."""
+    order = 4
+    n = 1 << order
+    x, y = _d2xy(order, np.arange(n * n))
+    step = np.abs(np.diff(x)) + np.abs(np.diff(y))
+    assert np.all(step == 1)
+
+
+@pytest.mark.parametrize("bpdx,bpdy", [(1, 1), (2, 1), (2, 2), (3, 2), (4, 1)])
+def test_forward_inverse_identity(bpdx, bpdy):
+    sc = SpaceCurve(bpdx, bpdy, level_max=4)
+    for level in range(3):
+        nx, ny = sc.blocks_at(level)
+        ii, jj = np.meshgrid(np.arange(nx), np.arange(ny), indexing="ij")
+        z = sc.forward(level, ii.ravel(), jj.ravel())
+        # bijective onto [0, nx*ny)
+        assert sorted(z.tolist()) == list(range(nx * ny))
+        x, y = sc.inverse(z, level)
+        np.testing.assert_array_equal(x, ii.ravel())
+        np.testing.assert_array_equal(y, jj.ravel())
+
+
+def test_nonsquare_compaction():
+    sc = SpaceCurve(2, 1, level_max=4)
+    assert not sc.is_regular
+    sc2 = SpaceCurve(2, 2, level_max=4)
+    assert sc2.is_regular
+
+
+def test_encode_unique_and_level_aware():
+    """encode() must give globally unique keys; children must sort after
+    their parent but before the parent's successor (depth-first curve
+    ordering, reference main.cpp:422-445)."""
+    sc = SpaceCurve(2, 1, level_max=4)
+    keys = []
+    for level in range(3):
+        nx, ny = sc.blocks_at(level)
+        ii, jj = np.meshgrid(np.arange(nx), np.arange(ny), indexing="ij")
+        k = sc.encode(np.full(ii.size, level), ii.ravel(), jj.ravel())
+        keys.extend(k.tolist())
+    assert len(set(keys)) == len(keys)
+
+    # Mixed-level forest ordering: take level-1 blocks, refine one into its
+    # 4 children; children's keys must fall between the parent's neighbors.
+    level = 1
+    nx, ny = sc.blocks_at(level)
+    ii, jj = np.meshgrid(np.arange(nx), np.arange(ny), indexing="ij")
+    z = sc.forward(level, ii.ravel(), jj.ravel())
+    order = np.argsort(z)
+    i_sorted, j_sorted = ii.ravel()[order], jj.ravel()[order]
+    k_parent = sc.encode(np.full(i_sorted.size, level), i_sorted, j_sorted)
+    # refine the 3rd block along the curve
+    pi, pj = int(i_sorted[2]), int(j_sorted[2])
+    ci = np.array([2 * pi, 2 * pi + 1, 2 * pi, 2 * pi + 1])
+    cj = np.array([2 * pj, 2 * pj, 2 * pj + 1, 2 * pj + 1])
+    k_children = sc.encode(np.full(4, level + 1), ci, cj)
+    assert k_children.min() > k_parent[1]
+    assert k_children.max() < k_parent[3]
