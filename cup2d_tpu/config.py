@@ -158,7 +158,7 @@ class SimConfig:
     @property
     def cells(self) -> tuple[int, int]:
         """Finest-level cell resolution cap."""
-        s = 1 << (self.level_max - 1)
+        s = 1 << max(self.level_max - 1, 0)
         return (self.bpdx * self.bs * s, self.bpdy * self.bs * s)
 
     @classmethod

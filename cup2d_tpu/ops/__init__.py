@@ -1,0 +1,10 @@
+from .stencil import (  # noqa: F401
+    weno5_plus,
+    weno5_minus,
+    weno_derivative,
+    advect_diffuse_rhs,
+    vorticity,
+    divergence_rhs,
+    pressure_gradient_update,
+    laplacian5,
+)

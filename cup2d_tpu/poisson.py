@@ -1,0 +1,192 @@
+"""Matrix-free pressure-Poisson solver: block-preconditioned BiCGSTAB.
+
+TPU-native replacement for the reference's GPU subsystem
+(`/root/reference/cuda.cu:24-548` BiCGSTABSolver + the host COO assembly at
+`main.cpp:5747-6020, 7031-7115`): instead of materializing a distributed
+sparse matrix for cuSPARSE, the variable-resolution 5-point Laplacian is
+applied *matrix-free* as a stencil (a function passed in by the caller), and
+the whole Krylov iteration runs inside one `lax.while_loop` on device — no
+host round-trips per iteration, no explicit halo staging (XLA inserts the
+collectives when the operand arrays are sharded).
+
+The preconditioner is the reference's exact block-Jacobi inverse
+(`main.cpp:6451-6488`): P = -inv(A_local) where A_local is the BS^2 x BS^2
+single-block 5-point Laplacian (`getA_local`, main.cpp:46-57), applied as a
+batched [nblocks, BS^2] x [BS^2, BS^2] GEMM — MXU work, where the reference
+used a batched cuBLAS GEMM (`cuda.cu:484-486`).
+
+Algorithm: flexible BiCGSTAB with Linf convergence on max(tol_abs,
+tol_rel * |r0|_inf), breakdown detection with re-orthogonalization restarts,
+and best-solution tracking (`x_opt`, cuda.cu:525-542) — same control flow as
+the reference's device loop (cuda.cu:403-548).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def block_precond_matrix(bs: int, dtype=np.float64) -> np.ndarray:
+    """P_inv = -inv(A_local), the negated inverse of the bs^2 x bs^2
+    single-block 5-point Laplacian with homogeneous Dirichlet truncation at
+    the block edge (reference getA_local main.cpp:46-57 + Cholesky inversion
+    main.cpp:6451-6488; dense inverse here — same matrix, host-side once)."""
+    n = bs * bs
+    ii = np.arange(n)
+    xi, yi = ii % bs, ii // bs
+    a = np.zeros((n, n), dtype=np.float64)
+    dx = np.abs(xi[:, None] - xi[None, :])
+    dy = np.abs(yi[:, None] - yi[None, :])
+    a[(dx + dy) == 1] = -1.0
+    np.fill_diagonal(a, 4.0)
+    return (-np.linalg.inv(a)).astype(dtype)
+
+
+def apply_block_precond(r: jnp.ndarray, p_inv: jnp.ndarray, bs: int) -> jnp.ndarray:
+    """z = P_inv r applied per bs x bs tile of a [Ny, Nx] field (batched GEMM).
+
+    Works for any [Ny, Nx] divisible by bs; the AMR path passes fields
+    already shaped [N, bs, bs] via `apply_block_precond_blocks`.
+    """
+    ny, nx = r.shape[-2], r.shape[-1]
+    nby, nbx = ny // bs, nx // bs
+    tiles = r.reshape(*r.shape[:-2], nby, bs, nbx, bs)
+    tiles = jnp.swapaxes(tiles, -3, -2).reshape(*r.shape[:-2], nby, nbx, bs * bs)
+    z = tiles @ p_inv.T  # P_inv is symmetric; .T keeps intent explicit
+    z = z.reshape(*r.shape[:-2], nby, nbx, bs, bs).swapaxes(-3, -2)
+    return z.reshape(r.shape)
+
+
+def apply_block_precond_blocks(r: jnp.ndarray, p_inv: jnp.ndarray) -> jnp.ndarray:
+    """Same, for block-forest layout [N, bs, bs]."""
+    n, bs, _ = r.shape
+    return (r.reshape(n, bs * bs) @ p_inv.T).reshape(n, bs, bs)
+
+
+class BiCGSTABResult(NamedTuple):
+    x: jnp.ndarray
+    iters: jnp.ndarray
+    residual: jnp.ndarray   # Linf of best residual seen
+    converged: jnp.ndarray
+
+
+class _State(NamedTuple):
+    x: jnp.ndarray
+    r: jnp.ndarray
+    rhat: jnp.ndarray
+    p: jnp.ndarray
+    v: jnp.ndarray
+    rho: jnp.ndarray
+    alpha: jnp.ndarray
+    omega: jnp.ndarray
+    it: jnp.ndarray
+    restarts: jnp.ndarray
+    x_opt: jnp.ndarray
+    norm_opt: jnp.ndarray
+    norm0: jnp.ndarray
+    done: jnp.ndarray
+
+
+def bicgstab(
+    A: Callable[[jnp.ndarray], jnp.ndarray],
+    b: jnp.ndarray,
+    M: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
+    x0: jnp.ndarray | None = None,
+    tol: float = 1e-3,
+    tol_rel: float = 1e-2,
+    max_iter: int = 1000,
+    max_restarts: int = 0,
+    sum_dtype=None,
+) -> BiCGSTABResult:
+    """Preconditioned flexible BiCGSTAB, whole loop jitted on device.
+
+    A, M are matrix-free operators on fields shaped like ``b``. Convergence
+    is Linf(r) <= max(tol, tol_rel * Linf(r0)) — the reference's criterion
+    (cuda.cu:434-436, 525-542). Inner products accumulate in ``sum_dtype``
+    (default: b's dtype; pass jnp.float64 for compensated f32 runs).
+    """
+    if M is None:
+        M = lambda v: v
+    dt_ = b.dtype
+    sd = sum_dtype or dt_
+
+    def dot(a_, b_):
+        return jnp.sum((a_ * b_).astype(sd)).astype(dt_)
+
+    def linf(a_):
+        return jnp.max(jnp.abs(a_))
+
+    x0 = jnp.zeros_like(b) if x0 is None else x0
+    r0 = b - A(x0)
+    norm0 = linf(r0)
+    target = jnp.maximum(jnp.asarray(tol, dt_), tol_rel * norm0)
+    one = jnp.asarray(1.0, dt_)
+
+    init = _State(
+        x=x0, r=r0, rhat=r0, p=jnp.zeros_like(b), v=jnp.zeros_like(b),
+        rho=one, alpha=one, omega=one,
+        it=jnp.asarray(0, jnp.int32), restarts=jnp.asarray(0, jnp.int32),
+        x_opt=x0, norm_opt=norm0, norm0=norm0,
+        done=norm0 <= target,
+    )
+
+    breakdown_eps = jnp.asarray(1e-21 if dt_ == jnp.float64 else 1e-30, dt_)
+
+    def cond(s: _State):
+        return (~s.done) & (s.it < max_iter)
+
+    def body(s: _State):
+        rho_new = dot(s.rhat, s.r)
+        # serious breakdown -> restart with rhat = r (cuda.cu:457-477)
+        norm_r = jnp.sqrt(dot(s.r, s.r))
+        norm_rhat = jnp.sqrt(dot(s.rhat, s.rhat))
+        breakdown = jnp.abs(rho_new) < (
+            jnp.asarray(1e-16, dt_) * norm_r * norm_rhat + breakdown_eps
+        )
+        can_restart = s.restarts < max_restarts
+        do_restart = breakdown & can_restart
+        give_up = breakdown & ~can_restart
+
+        rhat = jnp.where(do_restart, s.r, s.rhat)
+        rho_new = jnp.where(do_restart, dot(rhat, s.r), rho_new)
+        beta = jnp.where(
+            do_restart, jnp.zeros_like(rho_new),
+            (rho_new / (s.rho + breakdown_eps)) * (s.alpha / (s.omega + breakdown_eps)),
+        )
+        p = s.r + beta * (s.p - s.omega * s.v)
+        z = M(p)
+        v = A(z)
+        alpha = rho_new / (dot(rhat, v) + breakdown_eps)
+        h = s.x + alpha * z
+        sres = s.r - alpha * v
+        zs = M(sres)
+        t = A(zs)
+        omega = dot(t, sres) / (dot(t, t) + breakdown_eps)
+        x = h + omega * zs
+        r = sres - omega * t
+
+        norm = linf(r)
+        better = norm < s.norm_opt
+        x_opt = jnp.where(better, x, s.x_opt)
+        norm_opt = jnp.where(better, norm, s.norm_opt)
+        done = (norm <= target) | give_up
+
+        return _State(
+            x=x, r=r, rhat=rhat, p=p, v=v,
+            rho=rho_new, alpha=alpha, omega=omega,
+            it=s.it + 1, restarts=s.restarts + do_restart.astype(jnp.int32),
+            x_opt=x_opt, norm_opt=norm_opt, norm0=s.norm0,
+            done=done,
+        )
+
+    final = jax.lax.while_loop(cond, body, init)
+    return BiCGSTABResult(
+        x=final.x_opt,
+        iters=final.it,
+        residual=final.norm_opt,
+        converged=final.norm_opt <= target,
+    )

@@ -1,0 +1,221 @@
+"""Uniform-grid execution path: the whole state is dense global arrays.
+
+When every block sits at one level (the reference's levelMax=1 degenerate
+case, and the oracle configuration for the AMR path), the TPU-idiomatic
+representation is NOT a block forest but plain `[Ny, Nx]` arrays: stencils
+become shifted slices XLA fuses into a few kernels, the Poisson solve is
+matrix-free over the same arrays, and sharding is a one-line
+`NamedSharding` over rows. This module is that path, end-to-end jitted.
+
+It reproduces the reference timestep (`/root/reference/main.cpp:6576-7290`):
+CFL dt control, two-stage Heun advection-diffusion (WENO5 + central
+diffusion), Brinkman penalization, pressure projection with the deltap
+formulation (initial guess = old pressure, main.cpp:7007-7027), and
+free-slip / Neumann box boundaries (main.cpp:3126-3256).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import SimConfig
+from .ops.stencil import (
+    advect_diffuse_rhs,
+    divergence_rhs,
+    laplacian5,
+    pressure_gradient_update,
+    vorticity,
+)
+from .poisson import apply_block_precond, bicgstab, block_precond_matrix
+
+
+# ---------------------------------------------------------------------------
+# Ghost padding with the reference's physical BCs (main.cpp:3126-3256):
+#  - vector: free-slip mirror — ghost takes the wall-adjacent cell's value
+#    with the normal component negated (zeroth-order, like the reference)
+#  - scalar: zero-Neumann copy of the wall-adjacent cell
+# ---------------------------------------------------------------------------
+
+def pad_scalar(p: jnp.ndarray, g: int) -> jnp.ndarray:
+    """[..., Ny, Nx] -> [..., Ny+2g, Nx+2g], Neumann copy (ScalarLab)."""
+    pad = [(0, 0)] * (p.ndim - 2) + [(g, g), (g, g)]
+    return jnp.pad(p, pad, mode="edge")
+
+
+def pad_vector(v: jnp.ndarray, g: int) -> jnp.ndarray:
+    """[..., 2, Ny, Nx] -> [..., 2, Ny+2g, Nx+2g], free-slip mirror
+    (VectorLab::applyBCface): u flips sign in x-ghost columns, v flips in
+    y-ghost rows; corners compose both flips — exactly the reference's
+    two-pass face sweep."""
+    ny, nx = v.shape[-2], v.shape[-1]
+    out = pad_scalar(v, g)
+    sx = jnp.ones(nx + 2 * g, dtype=v.dtype).at[:g].set(-1).at[nx + g :].set(-1)
+    sy = jnp.ones(ny + 2 * g, dtype=v.dtype).at[:g].set(-1).at[ny + g :].set(-1)
+    u = out[..., 0, :, :] * sx[None, :]
+    w = out[..., 1, :, :] * sy[:, None]
+    return jnp.stack([u, w], axis=-3)
+
+
+class FlowState(NamedTuple):
+    """Device-side per-step state (the reference's 7 field grids,
+    main.cpp:3264-3278, minus the scratch fields XLA fuses away; the
+    previous pressure — the reference's ``pold`` — is just ``pres`` at
+    entry to step()).
+
+    ``us`` is the full solid velocity (rigid + deformation) targeted by
+    penalization (main.cpp:6974-6975); ``udef`` is the *deformation-only*
+    part entering the pressure RHS's chi*div(udef) term (main.cpp:6980-7006
+    accumulates only o->udef — rigid motion is divergence-free and dropped).
+    """
+
+    vel: jnp.ndarray    # [2, Ny, Nx]
+    pres: jnp.ndarray   # [Ny, Nx]
+    chi: jnp.ndarray    # [Ny, Nx]
+    us: jnp.ndarray     # [2, Ny, Nx]
+    udef: jnp.ndarray   # [2, Ny, Nx]
+
+
+class UniformGrid:
+    """Geometry + jitted operators for one uniform resolution."""
+
+    def __init__(self, cfg: SimConfig, level: Optional[int] = None):
+        self.cfg = cfg
+        lvl = cfg.level_start if level is None else level
+        self.level = lvl
+        self.nx = cfg.bpdx * cfg.bs << lvl
+        self.ny = cfg.bpdy * cfg.bs << lvl
+        self.h = cfg.h_at(lvl)
+        self.dtype = jnp.dtype(cfg.dtype)
+        self.p_inv = jnp.asarray(block_precond_matrix(cfg.bs), dtype=self.dtype)
+        # f64 dot-product accumulation when fields are f32 AND x64 is
+        # available (the Krylov scalars are precision-critical, SURVEY.md §7
+        # hard part 5). Without x64, XLA's tree reduction keeps f32 error at
+        # ~log(N)*eps, which holds to the reference's 1e-3 tolerance.
+        self.sum_dtype = (
+            jnp.float64
+            if (self.dtype == jnp.float32 and jax.config.jax_enable_x64)
+            else None
+        )
+
+    # -- coordinate helpers (cell centers) --
+    def cell_centers(self):
+        x = (np.arange(self.nx) + 0.5) * self.h
+        y = (np.arange(self.ny) + 0.5) * self.h
+        return np.meshgrid(x, y, indexing="xy")  # X[j,i], Y[j,i] -> [Ny, Nx]
+
+    def zero_state(self) -> FlowState:
+        z = jnp.zeros((self.ny, self.nx), dtype=self.dtype)
+        zv = jnp.zeros((2, self.ny, self.nx), dtype=self.dtype)
+        return FlowState(vel=zv, pres=z, chi=z, us=zv, udef=zv)
+
+    # -- dt control (main.cpp:6579-6595) --
+    def compute_dt(self, vel: jnp.ndarray) -> jnp.ndarray:
+        umax = jnp.max(jnp.abs(vel))
+        dt_diff = 0.25 * self.h * self.h / (self.cfg.nu + 0.25 * self.h * umax)
+        dt_adv = self.h / (umax + 1e-8)
+        return jnp.minimum(dt_diff, self.cfg.cfl * dt_adv)
+
+    # -- Poisson operator: undivided 5-point Laplacian w/ Neumann walls --
+    def laplacian(self, p: jnp.ndarray) -> jnp.ndarray:
+        return laplacian5(pad_scalar(p, 1), 1)
+
+    def precond(self, r: jnp.ndarray) -> jnp.ndarray:
+        return apply_block_precond(r, self.p_inv, self.cfg.bs)
+
+    def pressure_solve(self, rhs: jnp.ndarray, exact: bool = False):
+        """Solve lap(dp) = rhs (undivided). ``exact`` reproduces the
+        reference's first-10-steps override — tol 0 with 100 restarts while
+        the pold initial guess is cold (main.cpp:7028-7030)."""
+        cfg = self.cfg
+        return bicgstab(
+            self.laplacian,
+            rhs,
+            M=self.precond if cfg.precond else None,
+            tol=0.0 if exact else cfg.poisson_tol,
+            tol_rel=0.0 if exact else cfg.poisson_tol_rel,
+            max_iter=cfg.max_poisson_iterations,
+            max_restarts=100 if exact else cfg.max_poisson_restarts,
+            sum_dtype=self.sum_dtype,
+        )
+
+    # -- one full projection step (the reference hot loop 6576-7290) --
+    def step(self, state: FlowState, dt: jnp.ndarray,
+             exact_poisson: bool = False) -> tuple[FlowState, dict]:
+        cfg = self.cfg
+        h = self.h
+        ih2 = 1.0 / (h * h)
+        vold = state.vel
+
+        # advection-diffusion, 2-stage Heun (main.cpp:6607-6642)
+        vel = state.vel
+        for c in (0.5, 1.0):
+            rhs = advect_diffuse_rhs(pad_vector(vel, 3), 3, h, cfg.nu, dt)
+            vel = vold + c * rhs * ih2
+
+        # Brinkman penalization implicit update (main.cpp:6961-6977):
+        # alpha = chi > 0.5 ? 1/(1 + lambda dt) : 1;  u <- alpha u + (1-alpha) u_s
+        alpha = jnp.where(state.chi > 0.5, 1.0 / (1.0 + cfg.lam * dt), 1.0)
+        vel = alpha * vel + (1.0 - alpha) * state.us
+
+        # pressure RHS in deltap form (main.cpp:7007-7027):
+        #   b = (h/2dt)[div u* - chi div u_def] - lap(pold)
+        pold = state.pres
+        b = divergence_rhs(
+            pad_vector(vel, 1), pad_vector(state.udef, 1), state.chi, 1, h, dt
+        )
+        b = b - laplacian5(pad_scalar(pold, 1), 1)
+
+        res = self.pressure_solve(b, exact=exact_poisson)
+        dp = res.x - jnp.mean(res.x)
+        pres = dp + pold - jnp.mean(pold)
+
+        # projection (main.cpp:7174-7187)
+        dv = pressure_gradient_update(pad_scalar(pres, 1), 1, h, dt)
+        vel = vel + dv * ih2
+
+        diag = {
+            "poisson_iters": res.iters,
+            "poisson_residual": res.residual,
+            "umax": jnp.max(jnp.abs(vel)),
+        }
+        return state._replace(vel=vel, pres=pres), diag
+
+    def vorticity_field(self, vel: jnp.ndarray) -> jnp.ndarray:
+        return vorticity(pad_vector(vel, 1), 1, self.h)
+
+
+class UniformSim:
+    """Host-side driver: owns time/step counters, jits the device step."""
+
+    def __init__(self, cfg: SimConfig, level: Optional[int] = None):
+        self.grid = UniformGrid(cfg, level)
+        self.cfg = cfg
+        self.state = self.grid.zero_state()
+        self.time = 0.0
+        self.step_count = 0
+        self._step = jax.jit(self.grid.step, static_argnames=("exact_poisson",))
+        self._dt = jax.jit(self.grid.compute_dt)
+
+    def advance(self, n_steps: int = 1, tend: Optional[float] = None,
+                exact_first_steps: bool = False):
+        """``exact_first_steps`` mirrors the reference's tol-0 solve for
+        steps < 10 (main.cpp:7028-7030); off by default because obstacle-free
+        validation runs don't need the cold-start treatment."""
+        diag = {}
+        for _ in range(n_steps):
+            if tend is not None and self.time >= tend:
+                break
+            dt = float(self._dt(self.state.vel))
+            if tend is not None:
+                dt = min(dt, tend - self.time + 1e-15)
+            exact = exact_first_steps and self.step_count < 10
+            self.state, diag = self._step(
+                self.state, jnp.asarray(dt, self.grid.dtype), exact_poisson=exact
+            )
+            self.time += dt
+            self.step_count += 1
+        return diag

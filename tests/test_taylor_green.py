@@ -1,0 +1,66 @@
+"""End-to-end validation on the Taylor-Green vortex in a free-slip box —
+the analytic case SURVEY.md §4 prescribes for the test pyramid the
+reference lacks. u = sin(pi x) cos(pi y) F(t), v = -cos(pi x) sin(pi y) F(t)
+satisfies free-slip walls exactly on [0,1]^2 and decays with
+F(t) = exp(-2 nu pi^2 t)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cup2d_tpu.config import SimConfig
+from cup2d_tpu.uniform import UniformSim
+
+
+def _tg_sim(level, nu=1e-3):
+    cfg = SimConfig(
+        bpdx=1, bpdy=1, level_max=level + 1, level_start=level, extent=1.0,
+        nu=nu, cfl=0.4, lam=0.0, poisson_tol=1e-11, poisson_tol_rel=0.0,
+        dtype="float64",
+    )
+    sim = UniformSim(cfg)
+    x, y = sim.grid.cell_centers()
+    u = np.sin(np.pi * x) * np.cos(np.pi * y)
+    v = -np.cos(np.pi * x) * np.sin(np.pi * y)
+    sim.state = sim.state._replace(vel=jnp.asarray(np.stack([u, v])))
+    return sim
+
+
+def test_taylor_green_decay():
+    nu = 1e-3
+    sim = _tg_sim(level=3, nu=nu)  # 64^2
+    w0 = float(jnp.max(jnp.abs(sim.grid.vorticity_field(sim.state.vel))))
+    t_end = 0.2
+    sim.advance(n_steps=10_000, tend=t_end)
+    assert sim.time >= t_end
+    w1 = float(jnp.max(jnp.abs(sim.grid.vorticity_field(sim.state.vel))))
+    expected = np.exp(-2 * nu * np.pi**2 * sim.time)
+    measured = w1 / w0
+    assert abs(measured - expected) / expected < 0.02, (measured, expected)
+
+
+def test_divergence_free_after_projection():
+    sim = _tg_sim(level=3)
+    sim.advance(n_steps=5)
+    from cup2d_tpu.ops.stencil import divergence_rhs
+    from cup2d_tpu.uniform import pad_vector
+
+    div = divergence_rhs(
+        pad_vector(sim.state.vel, 1),
+        pad_vector(sim.state.udef, 1),
+        sim.state.chi, 1, sim.grid.h, 1.0,
+    )
+    # The central (+-1) divergence of a centrally-projected field is zero
+    # only to discretization error O(h^2) — the compact 5-point Laplacian
+    # is not the composition div∘grad (same property as the reference).
+    # Physical div ~ 2.4e-4 at 64^2; the rhs here carries a 0.5*h scaling.
+    assert float(jnp.max(jnp.abs(div))) < 1e-5
+
+
+def test_velocity_stays_bounded():
+    """Free-slip box + projection: energy cannot grow."""
+    sim = _tg_sim(level=2)
+    e0 = float(jnp.sum(sim.state.vel**2))
+    sim.advance(n_steps=20)
+    e1 = float(jnp.sum(sim.state.vel**2))
+    assert e1 <= e0 * 1.001
