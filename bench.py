@@ -1,0 +1,83 @@
+"""Benchmark: cells·timesteps/second of the full projection step.
+
+Runs the flagship uniform-grid solver (Taylor–Green initial condition, the
+reference's Poisson tolerances from run.sh) for a timed batch of steps on
+whatever backend JAX finds (real TPU chip under the driver; CPU locally)
+and prints ONE JSON line.
+
+Baseline: the reference publishes no numbers (BASELINE.md); the
+driver-defined north star is >= 1 full timestep/sec at 8192^2 on v5e-8
+(/root/repo/BASELINE.json), i.e. 8192^2 = 67.1M cells·steps/s.
+``vs_baseline`` is measured throughput / that target.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+BASELINE_CELLS_STEPS_PER_SEC = 8192.0 * 8192.0  # 1 step/s @ 8192^2 target
+
+
+def main():
+    size = int(os.environ.get("BENCH_SIZE", "1024"))
+    n_warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    n_steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.uniform import UniformGrid, taylor_green_state
+
+    # square domain of size x size cells: bpdx=bpdy=1, level = log2(size/bs)
+    level = int(np.log2(size // 8))
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=1, level_start=0,
+                    extent=1.0, nu=4e-5, cfl=0.5, dtype="float32")
+    grid = UniformGrid(cfg, level=level)
+    state = taylor_green_state(grid)
+
+    step = jax.jit(grid.step, static_argnames=("exact_poisson",))
+    dt = jnp.asarray(0.25 * grid.h, grid.dtype)
+
+    for _ in range(n_warmup):
+        state, diag = step(state, dt)
+    jax.block_until_ready(state.vel)
+
+    # no host sync inside the timed loop — iteration counts are read after
+    diags = []
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, diag = step(state, dt)
+        diags.append(diag["poisson_iters"])
+    jax.block_until_ready(state.vel)
+    t1 = time.perf_counter()
+    iters_total = int(sum(int(d) for d in diags))
+
+    wall = t1 - t0
+    cells = grid.nx * grid.ny
+    cells_steps_per_sec = cells * n_steps / wall
+    poisson_ms_per_iter = (wall / max(iters_total, 1)) * 1e3
+
+    print(json.dumps({
+        "metric": "cells_steps_per_sec",
+        "value": round(cells_steps_per_sec, 1),
+        "unit": "cells*steps/s",
+        "vs_baseline": round(
+            cells_steps_per_sec / BASELINE_CELLS_STEPS_PER_SEC, 4
+        ),
+        "grid": f"{size}x{size}",
+        "steps": n_steps,
+        "wall_s": round(wall, 3),
+        "poisson_ms_per_iter": round(poisson_ms_per_iter, 3),
+        "poisson_iters_total": iters_total,
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""Distributed execution: device meshes, shardings, halo exchange.
+
+TPU-native replacement for the reference's MPI layer (SURVEY.md §2.2,
+§2.8): spatial domain decomposition becomes `jax.sharding.NamedSharding`
+over a `Mesh`, halo traffic becomes XLA collective-permutes inserted by
+GSPMD (or explicit `lax.ppermute` in the shard_map path), and the ~40
+MPI_Allreduce call sites become `psum`/`pmax` reductions that XLA places
+on ICI.
+"""
+
+from .mesh import (  # noqa: F401
+    make_mesh,
+    scalar_spec,
+    vector_spec,
+    shard_state,
+    ShardedUniformSim,
+)

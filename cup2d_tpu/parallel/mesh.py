@@ -1,0 +1,105 @@
+"""Device mesh + sharded uniform-grid execution.
+
+The reference decomposes space over MPI ranks along the SFC and hand-plans
+point-to-point halo messages (`/root/reference/main.cpp:909-2142`). The
+TPU-native equivalent is declarative: fields carry a `NamedSharding` that
+splits the x-axis of the domain across the mesh, and XLA's SPMD partitioner
+inserts the halo collective-permutes for every shifted-slice stencil read,
+plus `all-reduce`s for the dt/residual reductions — the entire §2.2 comm
+runtime of the reference collapses into sharding annotations.
+
+The mesh axis is named ``"x"``: for a 2-D incompressible flow the natural
+"data-parallel" axis is space itself (SURVEY.md §2.8 — spatial domain
+decomposition is this code's DP; there is no batch/tensor/pipeline axis in
+a single simulation). Multi-host TPU slices extend the same mesh over DCN
+transparently.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import SimConfig
+from ..uniform import FlowState, UniformSim
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D device mesh over the spatial x-axis.
+
+    On a real v5e-8 slice this is the 8-chip ICI ring; in tests it is the
+    CPU-forced virtual device set (conftest.py).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), ("x",))
+
+
+def scalar_spec() -> P:
+    """[Ny, Nx] fields: split columns across the mesh."""
+    return P(None, "x")
+
+
+def vector_spec() -> P:
+    """[2, Ny, Nx] fields."""
+    return P(None, None, "x")
+
+
+def shard_state(state: FlowState, mesh: Mesh) -> FlowState:
+    """Place a FlowState with x-split shardings on the mesh."""
+    sv = NamedSharding(mesh, vector_spec())
+    ss = NamedSharding(mesh, scalar_spec())
+    return FlowState(
+        vel=jax.device_put(state.vel, sv),
+        pres=jax.device_put(state.pres, ss),
+        chi=jax.device_put(state.chi, ss),
+        us=jax.device_put(state.us, sv),
+        udef=jax.device_put(state.udef, sv),
+    )
+
+
+class ShardedUniformSim(UniformSim):
+    """Uniform-grid solver executing SPMD over a device mesh.
+
+    Same numerics and driver loop as `UniformSim`; the only difference is
+    placement: the state lives x-split across devices and the jitted step
+    is compiled with those shardings, so stencil halos ride ICI
+    collective-permutes and reductions are cross-device all-reduces —
+    the reference's `sync1` + `MPI_Allreduce` pattern with zero
+    hand-written communication code.
+    """
+
+    def __init__(self, cfg: SimConfig, mesh: Mesh, level: Optional[int] = None):
+        super().__init__(cfg, level)
+        self.mesh = mesh
+        if self.grid.nx % mesh.devices.size != 0:
+            raise ValueError(
+                f"Nx={self.grid.nx} not divisible by mesh size "
+                f"{mesh.devices.size}"
+            )
+        state_shardings = FlowState(
+            vel=NamedSharding(mesh, vector_spec()),
+            pres=NamedSharding(mesh, scalar_spec()),
+            chi=NamedSharding(mesh, scalar_spec()),
+            us=NamedSharding(mesh, vector_spec()),
+            udef=NamedSharding(mesh, vector_spec()),
+        )
+        self.state = shard_state(self.state, mesh)
+        self._step = jax.jit(
+            self.grid.step,
+            static_argnames=("exact_poisson",),
+            out_shardings=(state_shardings, None),
+        )
+
+    def set_state(self, state: FlowState):
+        self.state = shard_state(state, self.mesh)

@@ -88,6 +88,7 @@ class _State(NamedTuple):
     x_opt: jnp.ndarray
     norm_opt: jnp.ndarray
     norm0: jnp.ndarray
+    best_it: jnp.ndarray
     done: jnp.ndarray
 
 
@@ -101,6 +102,7 @@ def bicgstab(
     max_iter: int = 1000,
     max_restarts: int = 0,
     sum_dtype=None,
+    stall_window: int = 25,
 ) -> BiCGSTABResult:
     """Preconditioned flexible BiCGSTAB, whole loop jitted on device.
 
@@ -108,6 +110,16 @@ def bicgstab(
     is Linf(r) <= max(tol, tol_rel * Linf(r0)) — the reference's criterion
     (cuda.cu:434-436, 525-542). Inner products accumulate in ``sum_dtype``
     (default: b's dtype; pass jnp.float64 for compensated f32 runs).
+
+    Beyond the reference's breakdown-restart (cuda.cu:457-477, budget
+    ``max_restarts``), stagnation triggers an unconditional *true-residual
+    restart*: if Linf(r) hasn't improved for ``stall_window`` iterations,
+    the recursive residual is replaced by b - A(x_opt) and the Krylov space
+    rebuilt from there. The reference never needs this because it iterates
+    in f64; the TPU production path is f32, where the recursive residual
+    drifts from the true one after ~50-100 iterations and the un-restarted
+    iteration flatlines above tolerance. Costs one extra operator
+    application per restart (lax.cond — not per iteration).
     """
     if M is None:
         M = lambda v: v
@@ -131,6 +143,7 @@ def bicgstab(
         rho=one, alpha=one, omega=one,
         it=jnp.asarray(0, jnp.int32), restarts=jnp.asarray(0, jnp.int32),
         x_opt=x0, norm_opt=norm0, norm0=norm0,
+        best_it=jnp.asarray(0, jnp.int32),
         done=norm0 <= target,
     )
 
@@ -140,29 +153,39 @@ def bicgstab(
         return (~s.done) & (s.it < max_iter)
 
     def body(s: _State):
-        rho_new = dot(s.rhat, s.r)
+        rho_probe = dot(s.rhat, s.r)
         # serious breakdown -> restart with rhat = r (cuda.cu:457-477)
         norm_r = jnp.sqrt(dot(s.r, s.r))
         norm_rhat = jnp.sqrt(dot(s.rhat, s.rhat))
-        breakdown = jnp.abs(rho_new) < (
+        breakdown = jnp.abs(rho_probe) < (
             jnp.asarray(1e-16, dt_) * norm_r * norm_rhat + breakdown_eps
         )
         can_restart = s.restarts < max_restarts
-        do_restart = breakdown & can_restart
-        give_up = breakdown & ~can_restart
+        stalled = (s.it - s.best_it) >= stall_window
+        do_restart = (breakdown & can_restart) | stalled
+        give_up = breakdown & ~can_restart & ~stalled
 
-        rhat = jnp.where(do_restart, s.r, s.rhat)
-        rho_new = jnp.where(do_restart, dot(rhat, s.r), rho_new)
+        # true-residual restart from the best solution seen; norm_opt is
+        # refreshed from the TRUE residual so a drifted-low recursive norm
+        # can't freeze x_opt and replay identical stall cycles
+        x, r = jax.lax.cond(
+            do_restart,
+            lambda: (s.x_opt, b - A(s.x_opt)),
+            lambda: (s.x, s.r),
+        )
+        norm_opt0 = jnp.where(do_restart, linf(r), s.norm_opt)
+        rhat = jnp.where(do_restart, r, s.rhat)
+        rho_new = jnp.where(do_restart, dot(rhat, r), rho_probe)
         beta = jnp.where(
             do_restart, jnp.zeros_like(rho_new),
             (rho_new / (s.rho + breakdown_eps)) * (s.alpha / (s.omega + breakdown_eps)),
         )
-        p = s.r + beta * (s.p - s.omega * s.v)
+        p = r + beta * (s.p - s.omega * s.v)
         z = M(p)
         v = A(z)
         alpha = rho_new / (dot(rhat, v) + breakdown_eps)
-        h = s.x + alpha * z
-        sres = s.r - alpha * v
+        h = x + alpha * z
+        sres = r - alpha * v
         zs = M(sres)
         t = A(zs)
         omega = dot(t, sres) / (dot(t, t) + breakdown_eps)
@@ -170,16 +193,20 @@ def bicgstab(
         r = sres - omega * t
 
         norm = linf(r)
-        better = norm < s.norm_opt
+        better = norm < norm_opt0
         x_opt = jnp.where(better, x, s.x_opt)
-        norm_opt = jnp.where(better, norm, s.norm_opt)
+        norm_opt = jnp.where(better, norm, norm_opt0)
         done = (norm <= target) | give_up
 
+        # only breakdown-triggered restarts consume the reference's
+        # max_restarts budget; stall restarts are unbudgeted
         return _State(
             x=x, r=r, rhat=rhat, p=p, v=v,
             rho=rho_new, alpha=alpha, omega=omega,
-            it=s.it + 1, restarts=s.restarts + do_restart.astype(jnp.int32),
+            it=s.it + 1,
+            restarts=s.restarts + (breakdown & can_restart).astype(jnp.int32),
             x_opt=x_opt, norm_opt=norm_opt, norm0=s.norm0,
+            best_it=jnp.where(better | do_restart, s.it, s.best_it),
             done=done,
         )
 

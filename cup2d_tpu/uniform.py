@@ -79,6 +79,20 @@ class FlowState(NamedTuple):
     udef: jnp.ndarray   # [2, Ny, Nx]
 
 
+def taylor_green_state(grid) -> "FlowState":
+    """Taylor–Green vortex compatible with the free-slip box: u = sin cos,
+    v = -cos sin has zero normal velocity at all four walls and decays
+    analytically as exp(-2 nu pi^2 (1/Lx^2 + 1/Ly^2) t) — the validation
+    case SURVEY.md §4 prescribes. Shared by tests, bench.py and
+    __graft_entry__.py."""
+    x, y = grid.cell_centers()
+    lx, ly = grid.cfg.extents
+    u = np.sin(np.pi * x / lx) * np.cos(np.pi * y / ly)
+    v = -(ly / lx) * np.cos(np.pi * x / lx) * np.sin(np.pi * y / ly)
+    vel = jnp.asarray(np.stack([u, v]), dtype=grid.dtype)
+    return grid.zero_state()._replace(vel=vel)
+
+
 class UniformGrid:
     """Geometry + jitted operators for one uniform resolution."""
 

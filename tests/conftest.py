@@ -10,7 +10,10 @@ Must run before `import jax` — hence top of conftest.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The image's sitecustomize pre-registers the TPU platform and pins
+# JAX_PLATFORMS — plain env setdefault does not win. jax.config.update
+# before first backend use does.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,6 +21,8 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # float64 on CPU: validates discretization order of accuracy at reference
 # precision (the reference is float64 throughout, main.cpp:24). The TPU
