@@ -1,0 +1,4 @@
+"""Obstacle / agent models: self-propelled fish, rigid disk."""
+
+from .disk import DiskShape  # noqa: F401
+from .fish import FishShape  # noqa: F401

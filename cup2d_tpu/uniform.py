@@ -143,60 +143,72 @@ class UniformGrid:
     def pressure_solve(self, rhs: jnp.ndarray, exact: bool = False):
         """Solve lap(dp) = rhs (undivided). ``exact`` reproduces the
         reference's first-10-steps override — tol 0 with 100 restarts while
-        the pold initial guess is cold (main.cpp:7028-7030)."""
+        the pold initial guess is cold (main.cpp:7028-7030). In f32 a
+        literal tol 0 is unreachable and would always burn max_iter, so
+        exact mode instead uses a *relative* floor ~the f32 residual floor
+        (scales with the RHS, unlike an absolute cutoff)."""
         cfg = self.cfg
+        exact_rel = 0.0 if self.dtype == jnp.float64 else 1e-5
         return bicgstab(
             self.laplacian,
             rhs,
             M=self.precond if cfg.precond else None,
             tol=0.0 if exact else cfg.poisson_tol,
-            tol_rel=0.0 if exact else cfg.poisson_tol_rel,
+            tol_rel=exact_rel if exact else cfg.poisson_tol_rel,
             max_iter=cfg.max_poisson_iterations,
             max_restarts=100 if exact else cfg.max_poisson_restarts,
             sum_dtype=self.sum_dtype,
         )
 
+    # -- step stages, shared by the obstacle-free and Simulation paths --
+    def advect_heun(self, vel: jnp.ndarray, dt) -> jnp.ndarray:
+        """Advection-diffusion, 2-stage Heun (main.cpp:6607-6642)."""
+        ih2 = 1.0 / (self.h * self.h)
+        vold = vel
+        for c in (0.5, 1.0):
+            rhs = advect_diffuse_rhs(
+                pad_vector(vel, 3), 3, self.h, self.cfg.nu, dt)
+            vel = vold + c * rhs * ih2
+        return vel
+
+    def project(self, vel, pres_old, chi, udef, dt, exact_poisson=False):
+        """deltap pressure solve + velocity correction
+        (main.cpp:7007-7187): b = (h/2dt)[div u* - chi div u_def] -
+        lap(pold); p = dp + pold (both mean-free); u += -dt/(2h) grad p.
+        Returns (vel, pres, solver_result)."""
+        h = self.h
+        ih2 = 1.0 / (h * h)
+        b = divergence_rhs(
+            pad_vector(vel, 1), pad_vector(udef, 1), chi, 1, h, dt)
+        b = b - laplacian5(pad_scalar(pres_old, 1), 1)
+        res = self.pressure_solve(b, exact=exact_poisson)
+        dp = res.x - jnp.mean(res.x)
+        pres = dp + pres_old - jnp.mean(pres_old)
+        dv = pressure_gradient_update(pad_scalar(pres, 1), 1, h, dt)
+        return vel + dv * ih2, pres, res
+
+    @staticmethod
+    def step_diag(vel, res) -> dict:
+        return {
+            "poisson_iters": res.iters,
+            "poisson_residual": res.residual,
+            "umax": jnp.max(jnp.abs(vel)),
+        }
+
     # -- one full projection step (the reference hot loop 6576-7290) --
     def step(self, state: FlowState, dt: jnp.ndarray,
              exact_poisson: bool = False) -> tuple[FlowState, dict]:
         cfg = self.cfg
-        h = self.h
-        ih2 = 1.0 / (h * h)
-        vold = state.vel
-
-        # advection-diffusion, 2-stage Heun (main.cpp:6607-6642)
-        vel = state.vel
-        for c in (0.5, 1.0):
-            rhs = advect_diffuse_rhs(pad_vector(vel, 3), 3, h, cfg.nu, dt)
-            vel = vold + c * rhs * ih2
+        vel = self.advect_heun(state.vel, dt)
 
         # Brinkman penalization implicit update (main.cpp:6961-6977):
         # alpha = chi > 0.5 ? 1/(1 + lambda dt) : 1;  u <- alpha u + (1-alpha) u_s
         alpha = jnp.where(state.chi > 0.5, 1.0 / (1.0 + cfg.lam * dt), 1.0)
         vel = alpha * vel + (1.0 - alpha) * state.us
 
-        # pressure RHS in deltap form (main.cpp:7007-7027):
-        #   b = (h/2dt)[div u* - chi div u_def] - lap(pold)
-        pold = state.pres
-        b = divergence_rhs(
-            pad_vector(vel, 1), pad_vector(state.udef, 1), state.chi, 1, h, dt
-        )
-        b = b - laplacian5(pad_scalar(pold, 1), 1)
-
-        res = self.pressure_solve(b, exact=exact_poisson)
-        dp = res.x - jnp.mean(res.x)
-        pres = dp + pold - jnp.mean(pold)
-
-        # projection (main.cpp:7174-7187)
-        dv = pressure_gradient_update(pad_scalar(pres, 1), 1, h, dt)
-        vel = vel + dv * ih2
-
-        diag = {
-            "poisson_iters": res.iters,
-            "poisson_residual": res.residual,
-            "umax": jnp.max(jnp.abs(vel)),
-        }
-        return state._replace(vel=vel, pres=pres), diag
+        vel, pres, res = self.project(
+            vel, state.pres, state.chi, state.udef, dt, exact_poisson)
+        return state._replace(vel=vel, pres=pres), self.step_diag(vel, res)
 
     def vorticity_field(self, vel: jnp.ndarray) -> jnp.ndarray:
         return vorticity(pad_vector(vel, 1), 1, self.h)

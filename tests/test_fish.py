@@ -1,0 +1,98 @@
+"""Host-side fish kinematics unit tests (reference main.cpp:111-161
+if2d_solve, 3476-3547 interpolation, 3991-4207 ongrid kinematics)."""
+
+import numpy as np
+
+from cup2d_tpu.models.fish import (
+    FishShape,
+    cubic_interp,
+    if2d_solve,
+    natural_cubic_spline,
+)
+
+
+def test_natural_cubic_spline_reproduces_line():
+    x = np.array([0.0, 0.3, 0.7, 1.0])
+    y = 2.0 * x + 1.0
+    xx = np.linspace(0, 1, 17)
+    yy = natural_cubic_spline(x, y, xx)
+    assert np.allclose(yy, 2.0 * xx + 1.0, atol=1e-12)
+
+
+def test_cubic_interp_endpoints_and_derivative():
+    y, dy = cubic_interp(0.0, 1.0, 0.0, 3.0, 7.0, dy0=0.5)
+    assert np.isclose(y, 3.0) and np.isclose(dy, 0.5)
+    y, dy = cubic_interp(0.0, 1.0, 1.0, 3.0, 7.0, dy0=0.5)
+    assert np.isclose(y, 7.0) and np.isclose(dy, 0.0)
+
+
+def test_if2d_solve_straight_line_when_curvature_zero():
+    rs = np.linspace(0.0, 1.0, 33)
+    z = np.zeros_like(rs)
+    rX, rY, vX, vY, norX, norY, vNorX, vNorY = if2d_solve(rs, z, z)
+    assert np.allclose(rX, rs) and np.allclose(rY, 0.0)
+    assert np.allclose(norX, 0.0) and np.allclose(norY, 1.0)
+    assert np.allclose(vX, 0.0) and np.allclose(vY, 0.0)
+
+
+def test_if2d_solve_arc_length_preserved():
+    """Frenet integration is an isometry: |r_{i+1}-r_i| == ds even for a
+    strongly curved midline (the renormalization keeps |ksi| = 1)."""
+    rs = np.linspace(0.0, 1.0, 65)
+    curv = 3.0 * np.sin(2 * np.pi * rs)
+    rX, rY, *_ = if2d_solve(rs, curv, np.zeros_like(rs))
+    seg = np.hypot(np.diff(rX), np.diff(rY))
+    assert np.allclose(seg, np.diff(rs), rtol=1e-10)
+
+
+def _fish():
+    return FishShape(0.2, 0.5, 0.5, 0.0, min_h=0.2 / 32)
+
+
+def test_fish_discretization():
+    f = _fish()
+    assert f.nm == len(f.rS) == len(f.width)
+    assert f.rS[0] == 0.0 and np.isclose(f.rS[-1], f.length)
+    assert np.all(np.diff(f.rS) >= 0)
+    assert np.all(f.width >= 0)
+    assert f.width[0] == 0.0 and np.isclose(f.width[-1], 0.0)
+    # head width profile: sqrt(2 wh s - s^2) with wh = 0.04 L
+    s = f.rS[1]
+    wh = 0.04 * f.length
+    assert np.isclose(f.width[1], np.sqrt(2 * wh * s - s * s))
+
+
+def test_midline_internal_momentum_removed():
+    """After the de-meaning pass the midline's own linear momentum
+    integral is ~0 (self-propulsion consistency, main.cpp:4094-4184)."""
+    f = _fish()
+    f.midline(0.37)
+    ds = np.empty(f.nm)
+    ds[0] = f.rS[1] - f.rS[0]
+    ds[-1] = f.rS[-1] - f.rS[-2]
+    ds[1:-1] = f.rS[2:] - f.rS[:-2]
+    fac1 = 2.0 * f.width
+    lmx = np.sum(f.vX * fac1 * ds / 2.0)
+    lmy = np.sum(f.vY * fac1 * ds / 2.0)
+    scale = max(np.max(np.abs(f.vX)), np.max(np.abs(f.vY))) * f.area
+    # fac2/fac3 width^3 terms are dropped here, so only near-zero
+    assert abs(lmx) < 0.05 * scale and abs(lmy) < 0.05 * scale
+
+
+def test_midline_moves_with_time():
+    f = _fish()
+    f.midline(0.1)
+    r1 = f.rY.copy()
+    f.midline(0.35)
+    assert not np.allclose(r1, f.rY)
+    assert np.max(np.abs(f.rY)) > 1e-3  # undulation has real amplitude
+
+
+def test_surface_polygon_closed_and_transformed():
+    f = FishShape(0.2, 1.0, 0.75, 90.0, min_h=0.2 / 32)
+    f.midline(0.2)
+    poly = f.surface_polygon()
+    assert poly.shape == (2 * f.nm, 2)
+    # 90 deg: fish extends along +y from its center, stays near x=1
+    assert np.ptp(poly[:, 1]) > np.ptp(poly[:, 0])
+    assert abs(np.mean(poly[:, 0]) - 1.0) < 0.05
