@@ -32,6 +32,8 @@ import numpy as np
 
 from .config import SimConfig
 from .models import DiskShape, FishShape
+from .ops.collision import collision_response, overlap_integrals
+from .ops.forces import FORCE_KEYS, surface_forces
 from .ops.obstacle import (
     chi_from_sdf,
     midline_udef,
@@ -53,6 +55,7 @@ class ObstacleFields(NamedTuple):
     chi: jnp.ndarray      # [Ny, Nx] combined (max over shapes)
     sdf: jnp.ndarray      # [Ny, Nx] combined signed distance
     chi_s: jnp.ndarray    # [S, Ny, Nx]
+    sdf_s: jnp.ndarray    # [S, Ny, Nx] per-shape signed distance
     udef_s: jnp.ndarray   # [S, 2, Ny, Nx] de-meaned deformation velocity
     com: jnp.ndarray      # [S, 2] chi-corrected centers of mass
     mass: jnp.ndarray     # [S]
@@ -94,7 +97,12 @@ class Simulation:
         self._rasterize = jax.jit(self._rasterize_impl)
         self._flow_step = jax.jit(
             self._flow_step_impl, static_argnames=("exact_poisson",))
+        self._flow_step_empty = jax.jit(
+            g.step, static_argnames=("exact_poisson",))
+        self._forces = jax.jit(self._forces_impl)
         self._dt = jax.jit(g.compute_dt)
+        self.compute_forces_every = 1   # 0 disables the diagnostics pass
+        self.force_log: Optional[object] = None  # file-like, CSV rows
 
     # ------------------------------------------------------------------
     # device: rasterization + chi + integrals (ongrid, main.cpp:4208-4630)
@@ -126,7 +134,8 @@ class Simulation:
 
         sdf_lab = pad_scalar(sdf, 1)
         chi = jnp.zeros((g.ny, g.nx), dtype=dtype)
-        chi_s, udef_s, coms, masses, inertias = [], [], [], [], []
+        chi_s, sdf_s, udef_s = [], [], []
+        coms, masses, inertias = [], [], []
         for k in range(S):
             inp = inputs[k]
             w = self._wins[k]
@@ -156,11 +165,15 @@ class Simulation:
             chi_full = scatter_window_set(
                 jnp.zeros((g.ny, g.nx), dtype=dtype), chi_w,
                 inp["oy"], inp["ox"])
+            sdf_full = scatter_window_set(
+                jnp.full((g.ny, g.nx), -1.0, dtype=dtype), sdf_wins[k],
+                inp["oy"], inp["ox"])
             udef_full = scatter_window_set(
                 jnp.zeros((2, g.ny, g.nx), dtype=dtype), ud,
                 inp["oy"], inp["ox"])
             chi = jnp.maximum(chi, chi_full)
             chi_s.append(chi_full)
+            sdf_s.append(sdf_full)
             udef_s.append(udef_full)
             coms.append(com)
             masses.append(m)
@@ -168,7 +181,8 @@ class Simulation:
 
         return ObstacleFields(
             chi=chi, sdf=sdf,
-            chi_s=jnp.stack(chi_s), udef_s=jnp.stack(udef_s),
+            chi_s=jnp.stack(chi_s), sdf_s=jnp.stack(sdf_s),
+            udef_s=jnp.stack(udef_s),
             com=jnp.stack(coms), mass=jnp.stack(masses),
             inertia=jnp.stack(inertias),
         )
@@ -202,6 +216,35 @@ class Simulation:
                 uvw.append(prescribed_uvw[k])
         uvw = jnp.stack(uvw) if S else jnp.zeros((0, 3), g.dtype)
 
+        # shape-shape collisions (main.cpp:6705-6943): chi-overlap
+        # integrals per shape (merged over opponents, like the
+        # reference's collisions[i] struct), then pairwise e=1 impulses
+        # applied sequentially in pair order
+        if S > 1:
+            colls = []
+            for i in range(S):
+                acc = jnp.zeros(7, dtype=g.dtype)
+                for j in range(S):
+                    if i == j:
+                        continue
+                    acc = acc + overlap_integrals(
+                        obs.chi_s[i], obs.chi_s[j], obs.sdf_s[i],
+                        obs.udef_s[i], uvw[i], obs.com[i], x, y)
+                colls.append(acc)
+            for i in range(S):
+                for j in range(i + 1, S):
+                    new_i, new_j, _hit = collision_response(
+                        colls[i], colls[j], uvw[i], uvw[j],
+                        obs.mass[i], obs.mass[j],
+                        obs.inertia[i], obs.inertia[j],
+                        obs.com[i], obs.com[j],
+                        self.shapes[i].length)
+                    uvw = uvw.at[i].set(new_i).at[j].set(new_j)
+            # prescribed-motion shapes are immovable: restore them
+            for k in range(S):
+                if not self.shapes[k].free:
+                    uvw = uvw.at[k].set(prescribed_uvw[k])
+
         # implicit penalization update, winner shape per cell
         # (main.cpp:6944-6979)
         if S:
@@ -219,11 +262,7 @@ class Simulation:
                 obs.chi > 0.5, 1.0 / (1.0 + cfg.lam * dt), 1.0)
             vel = alpha * vel + (1.0 - alpha) * us
 
-            # deformation-velocity field for the pressure RHS
-            # (main.cpp:6980-7006: sum where chi_s >= CHI)
-            udef = jnp.sum(
-                jnp.where((obs.chi_s >= obs.chi)[:, None], obs.udef_s, 0.0),
-                axis=0)
+            udef = self._combined_udef(obs)
         else:
             us = jnp.zeros_like(vel)
             udef = jnp.zeros_like(vel)
@@ -234,6 +273,32 @@ class Simulation:
         new_state = state._replace(vel=vel, pres=pres, chi=obs.chi,
                                    us=us, udef=udef)
         return new_state, uvw, g.step_diag(vel, res)
+
+    # ------------------------------------------------------------------
+    # device: surface force diagnostics (main.cpp:7188-7284)
+    # ------------------------------------------------------------------
+    def _forces_impl(self, state: FlowState, obs: ObstacleFields, uvw):
+        g = self.grid
+        out = []
+        for k in range(len(self.shapes)):
+            out.append(surface_forces(
+                state.vel, state.pres, obs.chi, obs.sdf,
+                obs.udef_s[k], obs.sdf_s[k], obs.com[k], uvw[k],
+                self.cfg.nu, g.h))
+        return out
+
+    def _log_forces(self, obs, uvw):
+        results = self._forces(self.state, obs, uvw)
+        for k, (s, r) in enumerate(zip(self.shapes, results)):
+            s.forces = {key: float(r[key]) for key in FORCE_KEYS}
+            if self.force_log is not None:
+                row = [f"{self.time:.8g}", str(k)] + [
+                    f"{s.forces[key]:.8g}" for key in FORCE_KEYS]
+                self.force_log.write(",".join(row) + "\n")
+
+    @staticmethod
+    def force_log_header() -> str:
+        return ",".join(["time", "shape"] + list(FORCE_KEYS))
 
     # ------------------------------------------------------------------
     # host driver
@@ -285,16 +350,33 @@ class Simulation:
             s.midline(self.time)
         obs = self._rasterize(self._shape_inputs())
         self._sync_shape_scalars(obs)
-        udef = jnp.sum(
-            jnp.where((obs.chi_s >= obs.chi)[:, None], obs.udef_s, 0.0),
-            axis=0)
+        udef = self._combined_udef(obs)
         vel = self.state.vel * (1.0 - obs.chi) + udef * obs.chi
         self.state = self.state._replace(vel=vel, chi=obs.chi)
         self._initialized = True
 
+    @staticmethod
+    def _combined_udef(obs: ObstacleFields) -> jnp.ndarray:
+        """Deformation-velocity field for the pressure RHS and the
+        initial blend: sum over shapes at cells where that shape's chi
+        ties-or-wins the combined chi (main.cpp:6980-7006; ties sum)."""
+        return jnp.sum(
+            jnp.where((obs.chi_s >= obs.chi)[:, None], obs.udef_s, 0.0),
+            axis=0)
+
     def step_once(self, dt: Optional[float] = None):
         g = self.grid
         cfg = self.cfg
+        if not self.shapes:
+            # obstacle-free: plain uniform step (no rasterization pass)
+            if dt is None:
+                dt = float(self._dt(self.state.vel))
+            exact = self.step_count < 10
+            self.state, diag = self._flow_step_empty(
+                self.state, jnp.asarray(dt, g.dtype), exact_poisson=exact)
+            self.time += dt
+            self.step_count += 1
+            return diag
         if not getattr(self, "_initialized", False):
             self.initialize()
         if dt is None:
@@ -320,6 +402,10 @@ class Simulation:
         for k, s in enumerate(self.shapes):
             if s.free:
                 s.u, s.v, s.omega = uvw_np[k]
+
+        if self.shapes and self.compute_forces_every and \
+                self.step_count % self.compute_forces_every == 0:
+            self._log_forces(obs, uvw)
 
         self.time += dt
         self.step_count += 1
