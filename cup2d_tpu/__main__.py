@@ -1,0 +1,79 @@
+"""CLI driver: ``python -m cup2d_tpu <reference flags>``.
+
+Runs the same case the reference's ``main()`` runs with the same flag
+names (`/root/reference/main.cpp:6306-6341`, `run.sh:1-22`): e.g.
+
+    python -m cup2d_tpu -bpdx 2 -bpdy 1 -levelMax 8 -levelStart 5 \
+        -Rtol 2 -Ctol 1 -extent 4 -CFL 0.5 -tend 10 -lambda 1e7 \
+        -nu 0.00004 -poissonTol 1e-3 -poissonTolRel 0.01 \
+        -maxPoissonRestarts 0 -maxPoissonIterations 1000 -AdaptSteps 20 \
+        -tdump 0.5 -shapes 'angle=0,L=0.2,xpos=1.8,ypos=0.8
+                            angle=180,L=0.2,xpos=1.6,ypos=0.8'
+
+Extra flags beyond the reference: ``-level N`` (uniform run at level N —
+until the AMR path lands this selects the single resolution), ``-dtype``,
+``-output DIR``, ``-checkpointEvery N``, ``-restart DIR``,
+``-maxSteps N``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from .config import CommandlineParser, SimConfig
+from .io import dump_uniform, load_checkpoint, save_checkpoint
+from .sim import Simulation
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    p = CommandlineParser(argv)
+    cfg = SimConfig.from_argv(argv)
+    level = p("level").asInt() if p.has("level") else cfg.level_start
+    outdir = p("output").asString() if p.has("output") else "."
+    ckpt_every = p("checkpointEvery").asInt() if p.has("checkpointEvery") \
+        else 0
+    max_steps = p("maxSteps").asInt() if p.has("maxSteps") else 10**9
+    os.makedirs(outdir, exist_ok=True)
+
+    sim = Simulation(cfg, level=level)
+    if p.has("restart"):
+        load_checkpoint(p("restart").asString(), sim)
+
+    force_path = os.path.join(outdir, "forces.csv")
+    resuming = p.has("restart") and os.path.exists(force_path)
+    sim.force_log = open(force_path, "a" if resuming else "w")
+    if not resuming:
+        sim.force_log.write(Simulation.force_log_header() + "\n")
+
+    if sim.shapes:
+        sim.initialize()   # so the t=0 dump sees the blended velocity
+
+    next_dump = sim.time if cfg.dump_time > 0 else float("inf")
+    while sim.time < cfg.end_time and sim.step_count < max_steps:
+        if sim.step_count % 5 == 0:
+            print(f"cup2d_tpu: {sim.step_count:08d} t={sim.time:.6f}",
+                  file=sys.stderr)
+        if cfg.dump_time > 0 and sim.time >= next_dump:
+            # catch the schedule up even when dt > tdump (the reference
+            # falls permanently behind there, main.cpp:6597-6602)
+            while next_dump <= sim.time:
+                next_dump += cfg.dump_time
+            path = os.path.join(outdir, f"vel.{sim.step_count:08d}")
+            dump_uniform(path, sim.time, sim.state.vel, sim.grid.h)
+        diag = sim.step_once()
+        if float(diag.get("umax", 0.0)) != float(diag.get("umax", 0.0)):
+            print("cup2d_tpu: NaN velocity, aborting", file=sys.stderr)
+            return 1
+        if ckpt_every and sim.step_count % ckpt_every == 0:
+            save_checkpoint(os.path.join(outdir, "checkpoint"), sim)
+
+    sim.force_log.close()
+    print(f"cup2d_tpu: done at t={sim.time:.6f} "
+          f"after {sim.step_count} steps", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
