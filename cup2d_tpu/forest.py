@@ -1,0 +1,125 @@
+"""Block forest: structure-of-arrays AMR grid.
+
+TPU-native inversion of the reference's pointer forest
+(`/root/reference/main.cpp:504-738` Info/treef/getf per-block mallocs):
+every field lives in ONE dense device array `[capacity, dim, BS, BS]`
+addressed by slot; the topology (level, block index, active mask, the
+(level, i, j) -> slot map) is small host-side numpy/dict state that only
+changes at regrid time. Device kernels always run over the full padded
+capacity — XLA sees static shapes; inactive slots hold zeros and are
+masked out of reductions.
+
+Blocks are kept in Hilbert-SFC order across levels (the reference's
+``id2`` ordering via SpaceCurve::Encode, main.cpp:422-446) so that the
+sharded multi-device path can split contiguous SFC ranges exactly like
+the reference partitions ranks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .config import SimConfig
+from .curve import SpaceCurve
+
+
+class Forest:
+    """Host topology + device field storage for one AMR run.
+
+    All fields share one topology (the reference keeps 7 independent
+    grids in lock-step, main.cpp:3264-3278 — here lock-step is free
+    because there is only one tree).
+    """
+
+    def __init__(self, cfg: SimConfig, capacity: int = 0,
+                 dtype=None):
+        self.cfg = cfg
+        self.bs = cfg.bs
+        self.dtype = jnp.dtype(dtype or cfg.dtype)
+        self.curve = SpaceCurve(cfg.bpdx, cfg.bpdy, cfg.level_max)
+        nb0 = cfg.bpdx * cfg.bpdy
+        n_init = nb0 << (2 * cfg.level_start)
+        self.capacity = capacity or max(
+            64, 4 * n_init,
+            4 * nb0 << (2 * min(cfg.level_max - 1, 3)))
+        self.blocks: Dict[Tuple[int, int, int], int] = {}
+        self.level = np.zeros(self.capacity, np.int32)
+        self.bi = np.zeros(self.capacity, np.int32)
+        self.bj = np.zeros(self.capacity, np.int32)
+        self.active = np.zeros(self.capacity, bool)
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self.fields: Dict[str, jnp.ndarray] = {}
+        self.version = 0   # bumped on every topology change
+
+        # initial uniform partition at level_start (main.cpp:6494-6541)
+        lvl = cfg.level_start
+        nbx, nby = cfg.bpdx << lvl, cfg.bpdy << lvl
+        for j in range(nby):
+            for i in range(nbx):
+                self.allocate(lvl, i, j)
+
+    # -- slot management ------------------------------------------------
+    def allocate(self, l: int, i: int, j: int) -> int:
+        if not self._free:
+            raise RuntimeError("forest capacity exhausted")
+        s = self._free.pop()
+        self.blocks[(l, i, j)] = s
+        self.level[s] = l
+        self.bi[s] = i
+        self.bj[s] = j
+        self.active[s] = True
+        self.version += 1
+        return s
+
+    def release(self, l: int, i: int, j: int) -> int:
+        s = self.blocks.pop((l, i, j))
+        self.active[s] = False
+        self._free.append(s)
+        self.version += 1
+        return s
+
+    def add_field(self, name: str, dim: int):
+        self.fields[name] = jnp.zeros(
+            (self.capacity, dim, self.bs, self.bs), dtype=self.dtype)
+
+    # -- queries --------------------------------------------------------
+    def nblocks_at(self, l: int) -> Tuple[int, int]:
+        return self.cfg.bpdx << l, self.cfg.bpdy << l
+
+    def h_at(self, l: int) -> float:
+        return self.cfg.h_at(l)
+
+    def slot(self, l: int, i: int, j: int) -> int:
+        return self.blocks.get((l, i, j), -1)
+
+    def order(self) -> np.ndarray:
+        """Active slots sorted by the level-aware SFC id (the reference's
+        id2/Encode order, main.cpp:422-446)."""
+        items = [(int(self.curve.encode(l, i, j)), s)
+                 for (l, i, j), s in self.blocks.items()]
+        items.sort()
+        return np.asarray([s for _, s in items], np.int32)
+
+    def origin(self, s: int) -> Tuple[float, float]:
+        h = self.h_at(int(self.level[s]))
+        return (float(self.bi[s]) * self.bs * h,
+                float(self.bj[s]) * self.bs * h)
+
+    def h_per_block(self, order: np.ndarray) -> np.ndarray:
+        return self.cfg.h0 / (1 << self.level[order]).astype(np.float64)
+
+    # -- cell ownership (the reference's treef queries) -----------------
+    def owner_relation(self, l: int, i: int, j: int) -> int:
+        """For block (l,i,j): 0 = active here, -1 = refined (children
+        active), -2 = coarser parent active, -3 = nothing (the reference
+        tree codes, main.cpp:672-688)."""
+        if (l, i, j) in self.blocks:
+            return 0
+        if (l + 1, 2 * i, 2 * j) in self.blocks:
+            return -1
+        if (l - 1, i // 2, j // 2) in self.blocks:
+            return -2
+        return -3

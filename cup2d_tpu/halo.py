@@ -1,0 +1,525 @@
+"""Ghost-cell assembly as gather tables: the TPU form of BlockLab.
+
+The reference assembles per-block ghost-padded scratch tiles with 770
+lines of branchy pointer code + an MPI message schedule
+(`/root/reference/main.cpp:2231-3000` BlockLab, `909-2142` Setup/sync1).
+The key observation (SURVEY.md §7): every ghost value is a fixed LINEAR
+combination of stored cell values — same-level copies (weight 1),
+fine-to-coarse 2x2 averages (weight 1/4), coarse-to-fine interpolation
+(TestInterp 2nd-order Taylor, the 1-D directional variant on faces, and
+the LI/LE blends toward interior fine cells, main.cpp:2203-2230 +
+2689-2999), and the free-slip / Neumann wall ghosts.
+
+So each (grid topology, stencil width, field kind) pair compiles ONCE —
+on the host, at regrid time — into three arrays:
+
+    dest [G]      flat index into the lab array [n_active * L * L]
+    idx  [G, K]   flat indices into field storage [capacity * BS * BS]
+    w    [G, K, dim] weights (vector fields carry per-component signs
+                  from the free-slip mirror)
+
+and the per-step device work is one batched gather + weighted sum —
+no message passing, no branches, MXU/VPU-friendly. The table builder
+below IS the specification of the reference's interpolation, written
+against global cell coordinates instead of lab-pointer arithmetic; the
+weights are validated cell-for-cell against polynomial reproduction
+tests (exact for degree <= 2 where the reference is 2nd order).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .forest import Forest
+
+
+def _cdiv(a: int, b: int) -> int:
+    """C-style truncating integer division (the reference's `/`)."""
+    return int(math.trunc(a / b))
+
+
+class Expr(dict):
+    """Linear expression: {(slot, cy, cx): weight_vec ndarray[dim]}."""
+
+    def scaled(self, f):
+        return Expr({k: w * f for k, w in self.items()})
+
+    def add(self, other, f=1.0):
+        for k, w in other.items():
+            cur = self.get(k)
+            self[k] = w * f if cur is None else cur + w * f
+
+    @staticmethod
+    def combo(*pairs):
+        e = Expr()
+        for other, f in pairs:
+            e.add(other, f)
+        return e
+
+
+class HaloTables(NamedTuple):
+    """Registered as a jax pytree with the int metadata as static aux
+    data, so jitted functions taking tables as arguments re-use compiled
+    executables whenever a regrid reproduces previously-seen shapes."""
+
+    dest: jnp.ndarray     # [G] int32 into labs flat [n_active*L*L]
+    idx: jnp.ndarray      # [G, K] int32 into fields flat [cap*BS*BS]
+    idx_ord: jnp.ndarray  # [G, K] int32 into SFC-ordered [n_active*BS*BS]
+    w: jnp.ndarray        # [G, K, dim]
+    n_active: int
+    L: int
+    g: int
+    dim: int
+
+
+jax.tree_util.register_pytree_node(
+    HaloTables,
+    lambda t: ((t.dest, t.idx, t.idx_ord, t.w),
+               (t.n_active, t.L, t.g, t.dim)),
+    lambda aux, ch: HaloTables(*ch, *aux),
+)
+
+
+def build_tables(forest: Forest, order: np.ndarray, g: int,
+                 tensorial: bool, dim: int) -> HaloTables:
+    """Build gather tables for all ghost cells of all active blocks."""
+    bs = forest.bs
+    L = bs + 2 * g
+    builder = _LabBuilder(forest, g, tensorial, dim)
+    dest, idx_rows, w_rows = [], [], []
+    kmax = 1
+    for ordpos, s in enumerate(order):
+        exprs = builder.block_ghosts(int(s))
+        for (ly, lx), e in exprs.items():
+            dest.append(ordpos * L * L + ly * L + lx)
+            ks = list(e.items())
+            kmax = max(kmax, len(ks))
+            idx_rows.append([slot * bs * bs + cy * bs + cx
+                             for (slot, cy, cx), _ in ks])
+            w_rows.append([w for _, w in ks])
+    G = len(dest)
+    idx = np.zeros((G, kmax), np.int32)
+    w = np.zeros((G, kmax, dim), np.float64)
+    for r in range(G):
+        n = len(idx_rows[r])
+        idx[r, :n] = idx_rows[r]
+        for k in range(n):
+            w[r, k] = w_rows[r][k]
+    # idx remapped to the SFC-ordered compact layout (for operands that
+    # live as [n_active, BS, BS], e.g. the Poisson Krylov vectors)
+    ordpos = np.zeros(forest.capacity, np.int64)
+    ordpos[order] = np.arange(len(order))
+    slot_of = idx // (bs * bs)
+    idx_ord = (ordpos[slot_of] * bs * bs + idx % (bs * bs)).astype(np.int32)
+    return HaloTables(
+        dest=jnp.asarray(np.asarray(dest, np.int32)),
+        idx=jnp.asarray(idx),
+        idx_ord=jnp.asarray(idx_ord),
+        w=jnp.asarray(w, dtype=forest.dtype),
+        n_active=len(order), L=L, g=g, dim=dim,
+    )
+
+
+def assemble_labs(field: jnp.ndarray, order, tables: HaloTables):
+    """[cap, dim, BS, BS] field -> [n_active, dim, L, L] ghost-padded labs.
+
+    One gather for the interiors (block reorder) + one batched
+    gather-matmul for every ghost cell of every block.
+    """
+    cap, dim, bs, _ = field.shape
+    t = tables
+    flat = field.transpose(1, 0, 2, 3).reshape(dim, cap * bs * bs)
+    ghosts = jnp.einsum("dgk,gkd->gd", flat[:, t.idx], t.w)  # [G, dim]
+    return _place(field[order], ghosts, t, bs)
+
+
+def assemble_labs_ordered(x: jnp.ndarray, tables: HaloTables):
+    """Same, for an operand already in SFC-ordered compact layout
+    [n_active, dim, BS, BS] (Poisson Krylov vectors)."""
+    n, dim, bs, _ = x.shape
+    t = tables
+    flat = x.transpose(1, 0, 2, 3).reshape(dim, n * bs * bs)
+    ghosts = jnp.einsum("dgk,gkd->gd", flat[:, t.idx_ord], t.w)
+    return _place(x, ghosts, t, bs)
+
+
+def _place(interior, ghosts, t: HaloTables, bs: int):
+    dim = interior.shape[1]
+    labs = jnp.zeros((t.n_active, dim, t.L, t.L), dtype=interior.dtype)
+    labs = labs.at[:, :, t.g:t.g + bs, t.g:t.g + bs].set(interior)
+    labs_flat = labs.transpose(1, 0, 2, 3).reshape(dim, -1)
+    labs_flat = labs_flat.at[:, t.dest].set(ghosts.T)
+    return labs_flat.reshape(dim, t.n_active, t.L, t.L).transpose(1, 0, 2, 3)
+
+
+class _LabBuilder:
+    """Builds ghost-cell linear expressions for one block at a time,
+    following the reference's BlockLab passes in order."""
+
+    def __init__(self, forest: Forest, g: int, tensorial: bool, dim: int):
+        self.f = forest
+        self.bs = forest.bs
+        self.g = g
+        self.dim = dim
+        # reference stencil convention: start = -g, end = g + 1
+        self.start = -g
+        self.end = g + 1
+        self.offset = _cdiv(self.start - 1, 2) - 1
+        self.nc_hi = self.bs // 2 + _cdiv(self.end, 2) + 1   # excl. offset
+        self.tensorial = tensorial
+        # use_averages (main.cpp:2266-2267)
+        self.use_averages = tensorial or self.start < -2 or self.end > 3
+
+    # -- cell resolution against the forest ----------------------------
+    def cell(self, slot: int, cy: int, cx: int) -> Expr:
+        return Expr({(slot, cy, cx): np.ones(self.dim)})
+
+    def resolve_fine(self, l: int, X: int, Y: int) -> Expr | None:
+        """Value of global level-l cell (X, Y) from level-l or finer
+        data (2x2 averages, recursively)."""
+        f = self.f
+        bs = self.bs
+        s = f.slot(l, X // bs, Y // bs)
+        if s >= 0:
+            return self.cell(s, Y % bs, X % bs)
+        if l + 1 < f.cfg.level_max:
+            parts = [self.resolve_fine(l + 1, 2 * X + a, 2 * Y + b)
+                     for a in (0, 1) for b in (0, 1)]
+            if all(p is not None for p in parts):
+                return Expr.combo(*[(p, 0.25) for p in parts])
+        return None
+
+    # -- tile (coarse version of the neighborhood) ----------------------
+    def tile_expr(self, blk, ci: int, cj: int) -> Expr:
+        """Coarse-tile cell (ci, cj) in block-local coarse coords.
+
+        Interior tile cells resolve against the forest at level l-1
+        (direct coarse cell, or averaged-down finer data — the
+        load()/FillCoarseVersion fills). Cells beyond a DOMAIN wall get
+        the zeroth-order BC: clamp to the tile-interior edge in the wall
+        direction with the vector normal component negated (Neumann2D /
+        applyBCface coarse variants, main.cpp:3153-3183, 3216-3246)."""
+        l, bi, bj = blk
+        bs2 = self.bs // 2
+        nbx, nby = self.f.nblocks_at(l)
+        flip = np.ones(self.dim)
+        if bi == 0 and ci < 0:
+            ci = 0
+            if self.dim == 2:
+                flip[0] = -1.0
+        if bi == nbx - 1 and ci >= bs2:
+            ci = bs2 - 1
+            if self.dim == 2:
+                flip[0] = -1.0
+        if bj == 0 and cj < 0:
+            cj = 0
+            if self.dim == 2:
+                flip[1] = -1.0
+        if bj == nby - 1 and cj >= bs2:
+            cj = bs2 - 1
+            if self.dim == 2:
+                flip[1] = -1.0
+        e = self.resolve_fine(l - 1, bi * bs2 + ci, bj * bs2 + cj)
+        if e is None:
+            # unreachable on a 2:1-balanced forest; clamp into the own
+            # footprint as a defensive fallback
+            e = self.resolve_fine(
+                l - 1, bi * bs2 + min(max(ci, 0), bs2 - 1),
+                bj * bs2 + min(max(cj, 0), bs2 - 1))
+            assert e is not None
+        if (flip != 1.0).any():
+            e = Expr({k: w * flip for k, w in e.items()})
+        return e
+
+    # -- main entry ------------------------------------------------------
+    def block_ghosts(self, slot: int):
+        f = self.f
+        bs = self.bs
+        g = self.g
+        l = int(f.level[slot])
+        bi = int(f.bi[slot])
+        bj = int(f.bj[slot])
+        nbx, nby = f.nblocks_at(l)
+        blk = (l, bi, bj)
+
+        out: dict[tuple[int, int], Expr] = {}
+
+        def lab_get(ix: int, iy: int):
+            """Current lab value at block-local fine coords (may be an
+            interior cell or an already-built ghost); None if that lab
+            cell has no value yet."""
+            key = (iy + g, ix + g)
+            if key in out:
+                return out[key]
+            if 0 <= ix < bs and 0 <= iy < bs:
+                return self.cell(slot, iy, ix)
+            return None
+
+        xskin = bi == 0 or bi == nbx - 1
+        yskin = bj == 0 or bj == nby - 1
+        xskip = -1 if bi == 0 else 1
+        yskip = -1 if bj == 0 else 1
+
+        coarser_codes = []
+        # pass 1: same-level and finer neighbors, resolved per ghost cell
+        # (icode order of the reference: y outer, x inner)
+        for cy in (-1, 0, 1):
+            for cx in (-1, 0, 1):
+                if cx == 0 and cy == 0:
+                    continue
+                if cx == xskip and xskin:
+                    continue
+                if cy == yskip and yskin:
+                    continue
+                if (not self.tensorial and not self.use_averages
+                        and abs(cx) + abs(cy) > 1):
+                    continue
+                rel = f.owner_relation(l, bi + cx, bj + cy)
+                if rel == -2:
+                    coarser_codes.append((cx, cy))
+                    continue
+                s0 = self.start if cx < 0 else (0 if cx == 0 else bs)
+                e0 = 0 if cx < 0 else (bs if cx == 0 else bs + self.end - 1)
+                s1 = self.start if cy < 0 else (0 if cy == 0 else bs)
+                e1 = 0 if cy < 0 else (bs if cy == 0 else bs + self.end - 1)
+                for iy in range(s1, e1):
+                    for ix in range(s0, e0):
+                        X = bi * bs + ix
+                        Y = bj * bs + iy
+                        e = self.resolve_fine(l, X, Y)
+                        if e is not None:
+                            out[(iy + g, ix + g)] = e
+
+        # pass 2: coarser neighbors (tile + interpolation)
+        for (cx, cy) in coarser_codes:
+            self._coarse_ghosts(blk, (cx, cy), out, lab_get)
+
+        # pass 3: wall BCs overwrite skin ghosts (applied last, like
+        # post_load's final _apply_bc)
+        self._apply_bc(blk, out)
+        return out
+
+    # -- coarse-neighbor interpolation ----------------------------------
+    def _coarse_ghosts(self, blk, code, out, lab_get):
+        bs = self.bs
+        g = self.g
+        cx, cy = code
+        s0 = self.start if cx < 0 else (0 if cx == 0 else bs)
+        e0 = 0 if cx < 0 else (bs if cx == 0 else bs + self.end - 1)
+        s1 = self.start if cy < 0 else (0 if cy == 0 else bs)
+        e1 = 0 if cy < 0 else (bs if cy == 0 else bs + self.end - 1)
+        sC0 = _cdiv(self.start - 1, 2) if cx < 0 else (
+            0 if cx == 0 else bs // 2)
+        sC1 = _cdiv(self.start - 1, 2) if cy < 0 else (
+            0 if cy == 0 else bs // 2)
+
+        def coarse_xx(ix):
+            return (ix - s0 - min(0, cx) * ((e0 - s0) % 2)) // 2 + sC0
+
+        def coarse_yy(iy):
+            return (iy - s1 - min(0, cy) * ((e1 - s1) % 2)) // 2 + sC1
+
+        def parity_x(ix):
+            return abs(ix - s0 - min(0, cx) * ((e0 - s0) % 2)) % 2
+
+        def parity_y(iy):
+            return abs(iy - s1 - min(0, cy) * ((e1 - s1) % 2)) % 2
+
+        # (a) TestInterp everywhere in the region (use_averages path,
+        # main.cpp:2741-2766)
+        if self.use_averages:
+            for iy in range(s1, e1):
+                YY = coarse_yy(iy)
+                for ix in range(s0, e0):
+                    XX = coarse_xx(ix)
+                    tile = {}
+                    for a in (-1, 0, 1):
+                        for b in (-1, 0, 1):
+                            tile[(a, b)] = self.tile_expr(
+                                blk, XX + a, YY + b)
+                    out[(iy + g, ix + g)] = _test_interp(
+                        tile, parity_x(ix), parity_y(iy))
+
+        if abs(cx) + abs(cy) != 1:
+            return
+
+        # (b) 1-D directional Taylor on the face (main.cpp:2767-2861)
+        bs2 = bs // 2
+        for iy in range(s1, e1, 2):
+            YY = coarse_yy(iy)
+            y = parity_y(iy)
+            iyp = -1 if abs(iy) % 2 == 1 else 1
+            dy = 0.25 * (2 * y - 1)
+            for ix in range(s0, e0, 2):
+                XX = coarse_xx(ix)
+                x = parity_x(ix)
+                ixp = -1 if abs(ix) % 2 == 1 else 1
+                dx = 0.25 * (2 * x - 1)
+                if ix < -2 or iy < -2 or ix > bs + 1 or iy > bs + 1:
+                    continue
+                c1 = self.tile_expr(blk, XX, YY)
+                if cx != 0:
+                    # vary along y
+                    if YY == 0:
+                        cp2 = self.tile_expr(blk, XX, YY + 2)
+                        cp1 = self.tile_expr(blk, XX, YY + 1)
+                        dudy = Expr.combo((cp2, -0.5), (c1, -1.5), (cp1, 2.0))
+                        dudy2 = Expr.combo((cp2, 1.0), (c1, 1.0), (cp1, -2.0))
+                    elif YY == bs2 - 1:
+                        cm2 = self.tile_expr(blk, XX, YY - 2)
+                        cm1 = self.tile_expr(blk, XX, YY - 1)
+                        dudy = Expr.combo((cm2, 0.5), (c1, 1.5), (cm1, -2.0))
+                        dudy2 = Expr.combo((cm2, 1.0), (c1, 1.0), (cm1, -2.0))
+                    else:
+                        cp1 = self.tile_expr(blk, XX, YY + 1)
+                        cm1 = self.tile_expr(blk, XX, YY - 1)
+                        dudy = Expr.combo((cp1, 0.5), (cm1, -0.5))
+                        dudy2 = Expr.combo((cp1, 1.0), (cm1, 1.0), (c1, -2.0))
+                    d1, d2 = dudy, dudy2
+
+                    def val(sgn):
+                        return Expr.combo((c1, 1.0), (d1, sgn * dy),
+                                          (d2, 0.5 * dy * dy))
+                    quads = [(ix, iy, val(+1)), (ix, iy + iyp, val(-1)),
+                             (ix + ixp, iy, val(+1)),
+                             (ix + ixp, iy + iyp, val(-1))]
+                else:
+                    if XX == 0:
+                        cp2 = self.tile_expr(blk, XX + 2, YY)
+                        cp1 = self.tile_expr(blk, XX + 1, YY)
+                        dudx = Expr.combo((cp2, -0.5), (c1, -1.5), (cp1, 2.0))
+                        dudx2 = Expr.combo((cp2, 1.0), (c1, 1.0), (cp1, -2.0))
+                    elif XX == bs2 - 1:
+                        cm2 = self.tile_expr(blk, XX - 2, YY)
+                        cm1 = self.tile_expr(blk, XX - 1, YY)
+                        dudx = Expr.combo((cm2, 0.5), (c1, 1.5), (cm1, -2.0))
+                        dudx2 = Expr.combo((cm2, 1.0), (c1, 1.0), (cm1, -2.0))
+                    else:
+                        cp1 = self.tile_expr(blk, XX + 1, YY)
+                        cm1 = self.tile_expr(blk, XX - 1, YY)
+                        dudx = Expr.combo((cp1, 0.5), (cm1, -0.5))
+                        dudx2 = Expr.combo((cp1, 1.0), (cm1, 1.0), (c1, -2.0))
+                    d1, d2 = dudx, dudx2
+
+                    def val(sgn):
+                        return Expr.combo((c1, 1.0), (d1, sgn * dx),
+                                          (d2, 0.5 * dx * dx))
+                    quads = [(ix, iy, val(+1)), (ix, iy + iyp, val(+1)),
+                             (ix + ixp, iy, val(-1)),
+                             (ix + ixp, iy + iyp, val(-1))]
+                for (jx, jy, e) in quads:
+                    if jx == ix and jy == iy:
+                        out[(jy + self.g, jx + self.g)] = e
+                    elif s0 <= jx < e0 and s1 <= jy < e1:
+                        out[(jy + self.g, jx + self.g)] = e
+
+        # (c) LI/LE corrections toward interior fine cells, sequential in
+        # loop order (main.cpp:2862-2931)
+        def li(a, b, c):
+            # kappa = (4a + 6c - 10b)/15; lambda = b - c - kappa
+            # out = 4 kappa + 2 lambda + c
+            k = Expr.combo((a, 4 / 15), (c, 6 / 15), (b, -10 / 15))
+            lam = Expr.combo((b, 1.0), (c, -1.0), (k, -1.0))
+            return Expr.combo((k, 4.0), (lam, 2.0), (c, 1.0))
+
+        def le(a, b, c):
+            k = Expr.combo((a, 4 / 15), (c, 6 / 15), (b, -10 / 15))
+            lam = Expr.combo((b, 1.0), (c, -1.0), (k, -1.0))
+            return Expr.combo((k, 9.0), (lam, 3.0), (c, 1.0))
+
+        for iy in range(s1, e1):
+            for ix in range(s0, e0):
+                if ix < -2 or iy < -2 or ix > bs + 1 or iy > bs + 1:
+                    continue
+                x = parity_x(ix)
+                y = parity_y(iy)
+                a = out.get((iy + g, ix + g))
+                if a is None:
+                    continue
+                if cx == 0 and cy == 1:
+                    args = (li, (ix, iy - 1), (ix, iy - 2)) if y == 0 \
+                        else (le, (ix, iy - 2), (ix, iy - 3))
+                elif cx == 0 and cy == -1:
+                    args = (li, (ix, iy + 1), (ix, iy + 2)) if y == 1 \
+                        else (le, (ix, iy + 2), (ix, iy + 3))
+                elif cy == 0 and cx == 1:
+                    args = (li, (ix - 1, iy), (ix - 2, iy)) if x == 0 \
+                        else (le, (ix - 2, iy), (ix - 3, iy))
+                else:
+                    args = (li, (ix + 1, iy), (ix + 2, iy)) if x == 1 \
+                        else (le, (ix + 2, iy), (ix + 3, iy))
+                fn, pb, pc = args
+                b_e = lab_get(*pb)
+                c_e = lab_get(*pc)
+                if b_e is None or c_e is None:
+                    continue
+                out[(iy + g, ix + g)] = fn(a, b_e, c_e)
+
+    # -- wall BCs --------------------------------------------------------
+    def _apply_bc(self, blk, out):
+        """Reference _apply_bc (main.cpp:3126-3256): ghost = value at the
+        wall-adjacent cell with the SAME tangential coordinate (which may
+        itself be a ghost filled earlier); vector normal component flips
+        sign. Faces applied in x0, x1, y0, y1 order, later passes
+        overwriting corners — exactly the reference's sequence."""
+        l, bi, bj = blk
+        bs = self.bs
+        g = self.g
+        nbx, nby = self.f.nblocks_at(l)
+        lo0, hi0 = self.start, bs + self.end - 1
+        slot = self.f.blocks[(l, bi, bj)]
+        sides = []
+        if bi == 0:
+            sides.append(("x", 0))
+        if bi == nbx - 1:
+            sides.append(("x", 1))
+        if bj == 0:
+            sides.append(("y", 0))
+        if bj == nby - 1:
+            sides.append(("y", 1))
+        for (dir_, side) in sides:
+            if dir_ == "x":
+                xs = range(lo0, 0) if side == 0 else range(bs, hi0)
+                ys = range(lo0, hi0)
+                edge = 0 if side == 0 else bs - 1
+            else:
+                xs = range(lo0, hi0)
+                ys = range(lo0, 0) if side == 0 else range(bs, hi0)
+                edge = 0 if side == 0 else bs - 1
+            flip = np.ones(self.dim)
+            if self.dim == 2:
+                flip[0 if dir_ == "x" else 1] = -1.0
+            for iy in ys:
+                for ix in xs:
+                    sx, sy = (edge, iy) if dir_ == "x" else (ix, edge)
+                    if 0 <= sx < bs and 0 <= sy < bs:
+                        base = Expr({(slot, sy, sx): np.ones(self.dim)})
+                    else:
+                        base = out.get((sy + g, sx + g))
+                        if base is None:
+                            continue
+                    out[(iy + g, ix + g)] = Expr(
+                        {k: w * flip for k, w in base.items()})
+
+
+def _test_interp(tile, x: int, y: int) -> Expr:
+    """2nd-order Taylor prolongation of a 3x3 coarse neighborhood to the
+    fine cell with parity (x, y) (TestInterp, main.cpp:2220-2230)."""
+    dx = 0.25 * (2 * x - 1)
+    dy = 0.25 * (2 * y - 1)
+    c = tile
+    dudx = Expr.combo((c[(1, 0)], 0.5), (c[(-1, 0)], -0.5))
+    dudy = Expr.combo((c[(0, 1)], 0.5), (c[(0, -1)], -0.5))
+    dudxdy = Expr.combo((c[(-1, -1)], 0.25), (c[(1, 1)], 0.25),
+                        (c[(1, -1)], -0.25), (c[(-1, 1)], -0.25))
+    dudx2 = Expr.combo((c[(-1, 0)], 1.0), (c[(1, 0)], 1.0), (c[(0, 0)], -2.0))
+    dudy2 = Expr.combo((c[(0, -1)], 1.0), (c[(0, 1)], 1.0), (c[(0, 0)], -2.0))
+    return Expr.combo(
+        (c[(0, 0)], 1.0), (dudx, dx), (dudy, dy),
+        (dudx2, 0.5 * dx * dx), (dudy2, 0.5 * dy * dy), (dudxdy, dx * dy),
+    )

@@ -144,21 +144,23 @@ def vorticity(vlab: jnp.ndarray, g: int, h):
 #   tmp = (h / 2 dt) * [ div(u*) - chi * div(u_def) ]   (undivided central)
 # ---------------------------------------------------------------------------
 
+def divergence(vlab: jnp.ndarray, g: int):
+    """Undivided central divergence of a vector lab
+    [..., 2, Ny+2g, Nx+2g] -> [..., Ny, Nx]."""
+    assert g >= 1
+    return (
+        shift(vlab, g, 0, 1)[..., 0, :, :] - shift(vlab, g, 0, -1)[..., 0, :, :]
+        + shift(vlab, g, 1, 0)[..., 1, :, :] - shift(vlab, g, -1, 0)[..., 1, :, :]
+    )
+
+
 def divergence_rhs(vlab: jnp.ndarray, ulab: jnp.ndarray, chi: jnp.ndarray,
                    g: int, h, dt):
     """vlab: velocity lab [..., 2, Ny+2g, Nx+2g]; ulab: u_def lab (same
     shape); chi: interior [..., Ny, Nx]. Returns h^2-scaled Poisson RHS."""
     assert g >= 1
     fac = 0.5 * h / dt
-    div_v = (
-        shift(vlab, g, 0, 1)[..., 0, :, :] - shift(vlab, g, 0, -1)[..., 0, :, :]
-        + shift(vlab, g, 1, 0)[..., 1, :, :] - shift(vlab, g, -1, 0)[..., 1, :, :]
-    )
-    div_u = (
-        shift(ulab, g, 0, 1)[..., 0, :, :] - shift(ulab, g, 0, -1)[..., 0, :, :]
-        + shift(ulab, g, 1, 0)[..., 1, :, :] - shift(ulab, g, -1, 0)[..., 1, :, :]
-    )
-    return fac * div_v - fac * chi * div_u
+    return fac * divergence(vlab, g) - fac * chi * divergence(ulab, g)
 
 
 # ---------------------------------------------------------------------------
