@@ -47,7 +47,11 @@ def main(argv=None) -> int:
     if not resuming:
         sim.force_log.write(Simulation.force_log_header() + "\n")
 
-    if sim.shapes:
+    if sim.shapes and not p.has("restart"):
+        # t=0 only: the chi-blend vel = vel(1-chi) + udef*chi would
+        # discard the rigid-motion part of a RESTORED body-interior
+        # velocity and silently fork the resumed trajectory (ADVICE.md
+        # r1); load_checkpoint already marks the sim initialized.
         sim.initialize()   # so the t=0 dump sees the blended velocity
 
     next_dump = sim.time if cfg.dump_time > 0 else float("inf")

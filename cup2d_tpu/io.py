@@ -129,17 +129,32 @@ def save_checkpoint(dirpath: str, sim) -> None:
     }
     with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
+    # swap order matters for crash safety: park the old checkpoint aside,
+    # move the new one into place, THEN delete the old — at every instant
+    # either dirpath or dirpath+'.old' holds a complete checkpoint (a
+    # rmtree-before-replace window would leave neither; ADVICE.md r1)
+    old = dirpath.rstrip("/") + ".old"
+    import shutil
+    if os.path.exists(old):
+        shutil.rmtree(old)
     if os.path.exists(dirpath):
-        import shutil
-        shutil.rmtree(dirpath)
+        os.replace(dirpath, old)
     os.replace(tmp, dirpath)
+    if os.path.exists(old):
+        shutil.rmtree(old)
 
 
 def load_checkpoint(dirpath: str, sim) -> None:
     """Restore state saved by save_checkpoint into ``sim`` (built with a
-    matching config/grid)."""
+    matching config/grid). Falls back to ``dirpath.old`` when a save
+    crashed between parking the previous checkpoint and installing the
+    new one."""
     import jax.numpy as jnp
 
+    if not os.path.exists(os.path.join(dirpath, "meta.json")):
+        old = dirpath.rstrip("/") + ".old"
+        if os.path.exists(os.path.join(old, "meta.json")):
+            dirpath = old
     with np.load(os.path.join(dirpath, "fields.npz")) as data:
         sim.state = type(sim.state)(**{
             k: jnp.asarray(data[k], dtype=sim.grid.dtype)

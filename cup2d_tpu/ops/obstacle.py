@@ -99,25 +99,24 @@ def chi_from_sdf(sdf_lab, dist_own, h):
     )
 
 
-def window_coords(ox, oy, w, h, dtype):
-    """Cell-center coordinates of a w x w window whose lower-left cell
-    index is (ox, oy): x[j, i], y[j, i] each [w, w]."""
-    ar = jnp.arange(w)
-    x = (ox + ar[None, :] + 0.5).astype(dtype) * h
-    y = (oy + ar[:, None] + 0.5).astype(dtype) * h
-    return jnp.broadcast_to(x, (w, w)), jnp.broadcast_to(y, (w, w))
+def window_coords(ox, oy, wx, wy, h, dtype):
+    """Cell-center coordinates of a wx x wy window whose lower-left cell
+    index is (ox, oy): x[j, i], y[j, i] each [wy, wx]."""
+    x = (ox + jnp.arange(wx)[None, :] + 0.5).astype(dtype) * h
+    y = (oy + jnp.arange(wy)[:, None] + 0.5).astype(dtype) * h
+    return jnp.broadcast_to(x, (wy, wx)), jnp.broadcast_to(y, (wy, wx))
 
 
 def scatter_window_max(field, win, oy, ox):
-    """field[oy:oy+w, ox:ox+w] = max(field_slice, win) (the reference's
+    """field[oy:oy+wy, ox:ox+wx] = max(field_slice, win) (the reference's
     per-block max-combining of dist/chi across shapes)."""
-    w = win.shape[-1]
-    cur = jax.lax.dynamic_slice(field, (oy, ox), (w, w))
+    wy, wx = win.shape[-2:]
+    cur = jax.lax.dynamic_slice(field, (oy, ox), (wy, wx))
     return jax.lax.dynamic_update_slice(field, jnp.maximum(cur, win), (oy, ox))
 
 
 def scatter_window_set(field, win, oy, ox):
-    """Per-component scatter of a [..., w, w] window into [..., Ny, Nx]."""
+    """Per-component scatter of a [..., wy, wx] window into [..., Ny, Nx]."""
     zero = jnp.zeros_like(oy)
     idx = (zero,) * (field.ndim - 2) + (oy, ox)
     return jax.lax.dynamic_update_slice(field, win, idx)

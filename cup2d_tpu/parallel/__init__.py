@@ -2,10 +2,9 @@
 
 TPU-native replacement for the reference's MPI layer (SURVEY.md §2.2,
 §2.8): spatial domain decomposition becomes `jax.sharding.NamedSharding`
-over a `Mesh`, halo traffic becomes XLA collective-permutes inserted by
-GSPMD (or explicit `lax.ppermute` in the shard_map path), and the ~40
-MPI_Allreduce call sites become `psum`/`pmax` reductions that XLA places
-on ICI.
+over a `Mesh`; GSPMD inserts the halo collective-permutes for shifted
+stencil reads and turns the ~40 MPI_Allreduce call sites into
+cross-device all-reduces placed on ICI.
 """
 
 from .mesh import (  # noqa: F401

@@ -13,13 +13,12 @@ CoM/d_gm bookkeeping             advection-diffusion RK2
                                  pressure Poisson (BiCGSTAB) + projection
 
 Two jitted calls per step: ``_rasterize`` (the reference's ongrid device
-part, main.cpp:4208-4630) and ``_flow_step`` (the rest of the loop,
-main.cpp:6607-7187). Shape count, midline sizes and window sizes are
-static, so both compile once.
-
-Not yet implemented from that range: shape-shape collision response
-(main.cpp:6705-6943) and surface force diagnostics (7188-7284) — bodies
-currently interpenetrate elastically-unresolved when they touch.
+part, main.cpp:4208-4630) and ``_flow_step`` (the rest of the loop —
+advection, penalization momentum solve, shape-shape collision impulses
+(main.cpp:6705-6943), projection, main.cpp:6607-7187). Shape count,
+midline sizes and window sizes are static, so both compile once. Surface
+force diagnostics (main.cpp:7188-7284) run as a third jitted call,
+``_forces``, logged per step to the force CSV.
 """
 
 from __future__ import annotations
@@ -89,11 +88,13 @@ class Simulation:
         self.state = self.grid.zero_state()
         g = self.grid
         # static window size per shape: the body diagonal plus the 4h
-        # safety the reference adds to segment AABBs (main.cpp:4237)
+        # safety the reference adds to segment AABBs (main.cpp:4237);
+        # clamped per axis so wide-but-short domains (bpdx=2, bpdy=1)
+        # keep full x-coverage when the body exceeds the y-extent
         self._wins = []
         for s in self.shapes:
             w = int(np.ceil(1.25 * s.length / g.h)) + 12
-            self._wins.append(min(w, min(g.nx, g.ny)))
+            self._wins.append((min(w, g.nx), min(w, g.ny)))
         self._rasterize = jax.jit(self._rasterize_impl)
         self._flow_step = jax.jit(
             self._flow_step_impl, static_argnames=("exact_poisson",))
@@ -118,11 +119,11 @@ class Simulation:
         sdf_wins, udef_wins = [], []
         for k in range(S):
             inp = inputs[k]
-            w = self._wins[k]
-            x, y = window_coords(inp["ox"], inp["oy"], w, h, dtype)
+            wx, wy = self._wins[k]
+            x, y = window_coords(inp["ox"], inp["oy"], wx, wy, h, dtype)
             # local origin at the window center for f32 accuracy
-            cx = (inp["ox"] + 0.5 * w).astype(dtype) * h
-            cy = (inp["oy"] + 0.5 * w).astype(dtype) * h
+            cx = (inp["ox"] + 0.5 * wx).astype(dtype) * h
+            cy = (inp["oy"] + 0.5 * wy).astype(dtype) * h
             poly = inp["poly"] - jnp.stack([cx, cy])
             d = polygon_sdf(x - cx, y - cy, poly)
             mid_r = inp["mid_r"] - jnp.stack([cx, cy])
@@ -138,13 +139,13 @@ class Simulation:
         coms, masses, inertias = [], [], []
         for k in range(S):
             inp = inputs[k]
-            w = self._wins[k]
+            wx, wy = self._wins[k]
             # window + 1 ghost of the combined sdf (padded field indices
             # shift by +1, so (oy, ox) addresses unpadded (oy-1, ox-1))
             lab = jax.lax.dynamic_slice(
-                sdf_lab, (inp["oy"], inp["ox"]), (w + 2, w + 2))
+                sdf_lab, (inp["oy"], inp["ox"]), (wy + 2, wx + 2))
             chi_w = chi_from_sdf(lab, sdf_wins[k], h)
-            x, y = window_coords(inp["ox"], inp["oy"], w, h, dtype)
+            x, y = window_coords(inp["ox"], inp["oy"], wx, wy, h, dtype)
 
             # CoM correction (main.cpp:4468-4487); zero-mass guard for
             # under-resolved bodies
@@ -165,8 +166,12 @@ class Simulation:
             chi_full = scatter_window_set(
                 jnp.zeros((g.ny, g.nx), dtype=dtype), chi_w,
                 inp["oy"], inp["ox"])
+            # background sentinel must fail the surface-band gate
+            # own_sdf > -4h at EVERY level's h (forces.py); -extent does,
+            # -1.0 does not once h >= 0.25 (ADVICE.md r1)
             sdf_full = scatter_window_set(
-                jnp.full((g.ny, g.nx), -1.0, dtype=dtype), sdf_wins[k],
+                jnp.full((g.ny, g.nx), -float(self.cfg.extent),
+                         dtype=dtype), sdf_wins[k],
                 inp["oy"], inp["ox"])
             udef_full = scatter_window_set(
                 jnp.zeros((2, g.ny, g.nx), dtype=dtype), ud,
@@ -307,9 +312,9 @@ class Simulation:
         g = self.grid
         out = []
         for k, s in enumerate(self.shapes):
-            w = self._wins[k]
-            ox = int(np.clip(round(s.com[0] / g.h) - w // 2, 0, g.nx - w))
-            oy = int(np.clip(round(s.com[1] / g.h) - w // 2, 0, g.ny - w))
+            wx, wy = self._wins[k]
+            ox = int(np.clip(round(s.com[0] / g.h) - wx // 2, 0, g.nx - wx))
+            oy = int(np.clip(round(s.com[1] / g.h) - wy // 2, 0, g.ny - wy))
             mid_r, mid_v, mid_nor, mid_vnor = s.midline_comp_frame()
             dt_ = g.dtype
             out.append({
@@ -364,6 +369,21 @@ class Simulation:
             jnp.where((obs.chi_s >= obs.chi)[:, None], obs.udef_s, 0.0),
             axis=0)
 
+    def _kinematic_dt_cap(self) -> float:
+        """Deforming bodies need dt well under their gait period: the
+        grid-umax CFL (main.cpp:6579-6595) cannot see the midline's
+        future motion when the flow is still quiescent (the curvature
+        scheduler ramps from zero), and on coarse grids the diffusive dt
+        limit 0.25 h^2/nu can exceed the period itself — advancing the
+        kinematics by O(period) per step is meaningless and blows up the
+        penalization. The reference dodges this only by always running
+        fine grids (h <= 1/1024 keeps the diffusive cap small). 1/20th
+        of the fastest period resolves the gait; obstacle-free and
+        rigid-shape runs are uncapped, exactly like the reference."""
+        periods = [float(s.current_period) for s in self.shapes
+                   if getattr(s, "current_period", 0.0) > 0.0]
+        return 0.05 * min(periods) if periods else float("inf")
+
     def step_once(self, dt: Optional[float] = None):
         g = self.grid
         cfg = self.cfg
@@ -381,6 +401,7 @@ class Simulation:
             self.initialize()
         if dt is None:
             dt = float(self._dt(self.state.vel))
+            dt = min(dt, self._kinematic_dt_cap())
 
         # ongrid host part (main.cpp:3992-4207)
         for s in self.shapes:
