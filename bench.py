@@ -1,14 +1,22 @@
 """Benchmark: cells·timesteps/second of the full projection step.
 
-Runs the flagship uniform-grid solver (Taylor–Green initial condition, the
-reference's Poisson tolerances from run.sh) for a timed batch of steps on
-whatever backend JAX finds (real TPU chip under the driver; CPU locally)
-and prints ONE JSON line.
+Runs the uniform-grid solver at the north-star size (8192^2 f32, the
+driver target in BASELINE.json: >= 1 step/s on v5e) from an initial
+state with O(1) velocity and real divergence content, so the Poisson
+solve iterates at the reference's production tolerances every step —
+round 1's bench measured a solver at 0 iterations (VERDICT.md Weak #1)
+because Taylor-Green keeps the undivided residual under the absolute
+tolerance at large N.
 
-Baseline: the reference publishes no numbers (BASELINE.md); the
-driver-defined north star is >= 1 full timestep/sec at 8192^2 on v5e-8
-(/root/repo/BASELINE.json), i.e. 8192^2 = 67.1M cells·steps/s.
-``vs_baseline`` is measured throughput / that target.
+Reports, besides cells*steps/s: Poisson iters/step and ms/iter (timed
+separately on the captured RHS), advection ms/step, and model-based MFU
+and HBM-bandwidth utilization from an explicit per-cell flop/byte count
+(the step is memory-bound stencil work — HBM utilization is the number
+that says how close to the roof we are; MFU is reported for
+completeness).
+
+Prints ONE JSON line (driver contract). BENCH_SIZE/BENCH_STEPS/
+BENCH_WARMUP env vars override the defaults.
 """
 
 from __future__ import annotations
@@ -25,58 +33,196 @@ import numpy as np
 
 BASELINE_CELLS_STEPS_PER_SEC = 8192.0 * 8192.0  # 1 step/s @ 8192^2 target
 
+# v5e single chip, public specs: 197 TFLOPS bf16 -> ~1/2 for f32 MXU work,
+# and 819 GB/s HBM. The stencil path is VPU/HBM work, so HBM is the roof.
+PEAK_F32_TFLOPS = 98.5
+PEAK_HBM_GBPS = 819.0
 
-def main():
-    size = int(os.environ.get("BENCH_SIZE", "1024"))
-    n_warmup = int(os.environ.get("BENCH_WARMUP", "3"))
-    n_steps = int(os.environ.get("BENCH_STEPS", "10"))
+# --- per-cell work model (counted from cup2d_tpu/ops/stencil.py) ---------
+# advect_diffuse_rhs per component per direction: WENO5 plus+minus
+# (~2x45 flops incl. smoothness indicators) + upwind select + diffusion
+# 5-point (~10) -> ~110; x2 directions x2 components x2 Heun stages ~ 880
+# plus penalization/projection/divergence epilogue ~ 60.
+FLOPS_STEP_PER_CELL = 940.0
+# BiCGSTAB iteration: 2 laplacians (6) + 2 block-precond GEMV rows
+# (2*BS^2 MAC/cell = 256) + ~8 axpy/dot sweeps (~16) -> ~290.
+FLOPS_ITER_PER_CELL = 290.0
+# bytes: advection reads vel(2f) x2 stages + writes, penalization, rhs,
+# projection: ~22 f32 field sweeps; Krylov iteration touches ~12 arrays.
+BYTES_STEP_PER_CELL = 22 * 4.0
+BYTES_ITER_PER_CELL = 12 * 4.0
 
+
+def bench_state(grid):
+    """O(1) velocity with genuine multi-scale divergence: a shear-layer
+    pair, a mid-scale mode, and a non-solenoidal mode at a FIXED 64
+    cells/wavelength. The last one makes the Poisson load
+    resolution-invariant (undivided divergence ~ A^2 * h * k stays
+    constant when k grows with N) — with physical-wavenumber-only
+    content the absolute 1e-3 tolerance becomes trivially satisfied at
+    large N and the bench degenerates to advection-only (round 1's
+    failure, VERDICT.md Weak #1). Free-slip-compatible normal components
+    (sin -> 0 at walls) keep the box BCs consistent."""
+    x, y = grid.cell_centers()
+    lx, ly = grid.cfg.extents
+    xs, ys = np.pi * x / lx, np.pi * y / ly
+    m = max(grid.nx // 64, 32)
+    u = (np.sin(xs) * np.cos(ys)
+         + 0.25 * np.sin(8 * xs) * np.cos(8 * ys)
+         + 0.3 * np.sin(m * xs) * np.sin(m * ys))
+    v = (-np.cos(xs) * np.sin(ys)
+         + 0.25 * np.sin(16 * ys) * np.sin(16 * xs)
+         + 0.3 * np.sin(m * ys) * np.sin(m * xs))
+    vel = jnp.asarray(np.stack([u, v]), dtype=grid.dtype)
+    return grid.zero_state()._replace(vel=vel)
+
+
+def _fence(x) -> float:
+    """Force completion of x's producer chain via a host scalar read.
+    jax.block_until_ready is NOT a reliable completion fence on remote
+    device tunnels (measured: returns in 0.02 ms while the queued
+    computation still runs); a data-dependent scalar transfer is."""
+    return float(x.reshape(-1)[0])
+
+
+def _latency_floor(probe) -> float:
+    """Per-readback host<->device round-trip cost, to subtract from
+    fenced wall times (measured ~100 ms on the tunneled TPU)."""
+    _fence(probe)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _fence(probe)
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def run_size(size: int, n_warmup: int, n_steps: int):
     from cup2d_tpu.config import SimConfig
-    from cup2d_tpu.uniform import UniformGrid, taylor_green_state
+    from cup2d_tpu.uniform import UniformGrid
 
-    # square domain of size x size cells: bpdx=bpdy=1, level = log2(size/bs)
     level = int(np.log2(size // 8))
     cfg = SimConfig(bpdx=1, bpdy=1, level_max=1, level_start=0,
                     extent=1.0, nu=4e-5, cfl=0.5, dtype="float32")
     grid = UniformGrid(cfg, level=level)
-    state = taylor_green_state(grid)
+    state = bench_state(grid)
 
     step = jax.jit(grid.step, static_argnames=("exact_poisson",))
-    dt = jnp.asarray(0.25 * grid.h, grid.dtype)
+    dt = jnp.asarray(0.5 * grid.h, grid.dtype)  # CFL 0.5 at umax ~ 1
 
     for _ in range(n_warmup):
         state, diag = step(state, dt)
-    jax.block_until_ready(state.vel)
+    _fence(state.vel)
+    lat = _latency_floor(dt)
 
-    # no host sync inside the timed loop — iteration counts are read after
-    diags = []
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, diag = step(state, dt)
-        diags.append(diag["poisson_iters"])
-    jax.block_until_ready(state.vel)
-    t1 = time.perf_counter()
-    iters_total = int(sum(int(d) for d in diags))
+    # full-step throughput; one fence (its latency subtracted), no other
+    # host syncs inside the timed region. The window auto-extends until
+    # it dwarfs the fence latency — a window at or below the latency
+    # floor would otherwise report pure jitter as throughput.
+    latency_bound = False
+    while True:
+        diags = []
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, diag = step(state, dt)
+            diags.append(diag["poisson_iters"])
+        _fence(state.vel)
+        t1 = time.perf_counter()
+        if (t1 - t0) >= 5.0 * lat or n_steps >= 640:
+            latency_bound = (t1 - t0) < 5.0 * lat
+            break
+        n_steps *= 4
+    wall = max(t1 - t0 - lat, 1e-9)
+    iters = [int(d) for d in diags]
+    iters_total = sum(iters)
 
-    wall = t1 - t0
+    # advection stage alone (the non-Poisson bulk of the step); extra
+    # reps at small sizes so the fence latency (~100 ms on the tunneled
+    # TPU) stays small against the measured window
+    adv = jax.jit(grid.advect_heun)
+    _fence(adv(state.vel, dt))
+    n_adv = max(3, n_steps, (2048 // max(size // 8, 1)) * n_steps)
+    t2 = time.perf_counter()
+    out = state.vel
+    for _ in range(n_adv):
+        out = adv(out, dt)
+    _fence(out)
+    advect_ms = max(
+        (time.perf_counter() - t2 - lat) / n_adv * 1e3, 0.0)
+
+    # Poisson stage alone, on a HARD solve: the t=0 RHS (cold pressure,
+    # full divergence content) at a tight relative tolerance, so ms/iter
+    # averages over a real iteration train even when the production
+    # steps above coast at 0-1 iterations thanks to the MG preconditioner
+    from cup2d_tpu.ops.stencil import divergence_rhs
+    from cup2d_tpu.poisson import bicgstab
+    from cup2d_tpu.uniform import pad_vector
+    state0 = bench_state(grid)
+    b = divergence_rhs(pad_vector(state0.vel, 1),
+                       pad_vector(state0.udef, 1),
+                       state0.chi, 1, grid.h, dt)
+    psolve = jax.jit(lambda bb: bicgstab(
+        grid.laplacian, bb, M=grid.mg, tol=0.0, tol_rel=1e-4,
+        max_iter=100))
+    res = psolve(b)
+    _fence(res.x)
+    t3 = time.perf_counter()
+    res = psolve(b)
+    _fence(res.x)
+    psolve_wall = max(time.perf_counter() - t3 - lat, 0.0)
+    psolve_iters = int(res.iters)
+    poisson_ms_per_iter = psolve_wall / max(psolve_iters, 1) * 1e3
+
     cells = grid.nx * grid.ny
     cells_steps_per_sec = cells * n_steps / wall
-    poisson_ms_per_iter = (wall / max(iters_total, 1)) * 1e3
-
-    print(json.dumps({
-        "metric": "cells_steps_per_sec",
-        "value": round(cells_steps_per_sec, 1),
-        "unit": "cells*steps/s",
-        "vs_baseline": round(
-            cells_steps_per_sec / BASELINE_CELLS_STEPS_PER_SEC, 4
-        ),
+    iters_per_step = iters_total / n_steps
+    flops = cells * (FLOPS_STEP_PER_CELL * n_steps
+                     + FLOPS_ITER_PER_CELL * iters_total)
+    bytes_ = cells * (BYTES_STEP_PER_CELL * n_steps
+                      + BYTES_ITER_PER_CELL * iters_total)
+    return {
         "grid": f"{size}x{size}",
+        "cells_steps_per_sec": round(cells_steps_per_sec, 1),
         "steps": n_steps,
         "wall_s": round(wall, 3),
-        "poisson_ms_per_iter": round(poisson_ms_per_iter, 3),
+        "step_ms": round(wall / n_steps * 1e3, 3),
+        "iters_per_step": round(iters_per_step, 2),
         "poisson_iters_total": iters_total,
+        "poisson_ms_per_iter": round(poisson_ms_per_iter, 3),
+        "poisson_solve_iters": psolve_iters,
+        "advect_ms_per_step": round(advect_ms, 3),
+        "mfu_pct": round(flops / wall / (PEAK_F32_TFLOPS * 1e12) * 100, 3),
+        "hbm_util_pct": round(bytes_ / wall / (PEAK_HBM_GBPS * 1e9) * 100, 1),
+        "latency_bound": latency_bound,
+    }
+
+
+def main():
+    size = int(os.environ.get("BENCH_SIZE", "8192"))
+    n_warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    n_steps = int(os.environ.get("BENCH_STEPS", "10"))
+    extra_sizes = [int(s) for s in
+                   os.environ.get("BENCH_EXTRA_SIZES", "").split(",") if s]
+
+    primary = run_size(size, n_warmup, n_steps)
+    secondary = {s: run_size(s, n_warmup, n_steps) for s in extra_sizes}
+
+    out = {
+        "metric": "cells_steps_per_sec",
+        "value": primary["cells_steps_per_sec"],
+        "unit": "cells*steps/s",
+        "vs_baseline": round(
+            primary["cells_steps_per_sec"] / BASELINE_CELLS_STEPS_PER_SEC, 4
+        ),
         "backend": jax.default_backend(),
-    }))
+        "dtype": "float32",
+        "peak_assumed": {"f32_tflops": PEAK_F32_TFLOPS,
+                         "hbm_gbps": PEAK_HBM_GBPS},
+        **primary,
+    }
+    if secondary:
+        out["secondary"] = secondary
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,60 @@
+"""Table-build scalability: the regrid-time host cost VERDICT r1 flagged.
+
+The reference rebuilds its MPI synchronizer plans after every regrid
+(main.cpp:5425-5437) for O(1e4-1e5) blocks; our equivalent is
+build_tables. The pattern-memoized builder must stay in seconds at
+thousands of blocks (the naive per-ghost-cell path measured 12.7 s for
+ONE table at 4.3k blocks on this 1-core host).
+"""
+
+import time
+
+import numpy as np
+
+from cup2d_tpu.config import SimConfig
+from cup2d_tpu.forest import Forest
+from cup2d_tpu.halo import build_tables
+
+
+def _adapted_forest(levels=3):
+    cfg = SimConfig(bpdx=2, bpdy=1, level_max=4 + levels, level_start=4,
+                    extent=4.0, dtype="float32")
+    f = Forest(cfg, capacity=100000)
+    for _ in range(levels - 1):
+        for (l, i, j) in list(f.blocks.keys()):
+            if l >= cfg.level_max - 1:
+                continue
+            nbx, nby = f.nblocks_at(l)
+            x, y = (i + 0.5) / nbx, (j + 0.5) / nby
+            if abs(x - y * 2 % 1.0) < 0.5 * (0.5 ** (l - cfg.level_start)):
+                f.release(l, i, j)
+                for a in (0, 1):
+                    for b in (0, 1):
+                        f.allocate(l + 1, 2 * i + a, 2 * j + b)
+    return f
+
+
+def test_build_tables_at_scale():
+    f = _adapted_forest()
+    order = f.order()
+    assert len(order) >= 4000, f"forest too small: {len(order)}"
+    t0 = time.perf_counter()
+    tables = {
+        "vec3": build_tables(f, order, 3, True, 2),
+        "vec1": build_tables(f, order, 1, False, 2),
+        "sca1": build_tables(f, order, 1, False, 1),
+        "vec1t": build_tables(f, order, 1, True, 2),
+        "sca1t": build_tables(f, order, 1, True, 1),
+    }
+    wall = time.perf_counter() - t0
+    # all 5 per-regrid tables; generous bound (CI hosts vary) that still
+    # catches a fallback to per-ghost-cell construction (~60 s here)
+    assert wall < 30.0, f"table build too slow: {wall:.1f}s"
+    # the split must hold: copy-type rows dominate interpolation rows
+    t = tables["vec3"]
+    assert t.dest_s.shape[0] > 5 * t.dest.shape[0]
+    # every ghost row lands inside the lab arrays
+    L = t.L
+    n = len(order)
+    assert int(np.max(np.asarray(t.dest_s))) < n * L * L
+    assert int(np.min(np.asarray(t.dest_s))) >= 0

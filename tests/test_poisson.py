@@ -101,3 +101,51 @@ def test_poisson_physical_convergence():
         errs.append(float(jnp.max(jnp.abs(p - (p_exact - p_exact.mean())))))
     order = np.log2(errs[0] / errs[1])
     assert order > 1.7, f"errors {errs}, order {order}"
+
+
+def test_multigrid_preconditioner_reduces_error():
+    """One V-cycle must reduce the error of lap(e)=r substantially (it
+    is the production preconditioner at every uniform size)."""
+    g = _grid(level=4)  # 128^2
+    rng = np.random.default_rng(7)
+    raw = rng.standard_normal((g.ny, g.nx))
+    b = jnp.asarray(raw - raw.mean())
+    e = g.mg(b)
+    r1 = b - g.laplacian(e)
+    # energy-norm style check on the l2 residual
+    assert float(jnp.linalg.norm(r1)) < 0.3 * float(jnp.linalg.norm(b))
+
+
+def test_multigrid_solver_iteration_count_flat_in_n():
+    """The point of MG: Krylov iterations stay O(1) as N grows (block
+    Jacobi degrades ~linearly in N_1d, measured 11 -> 174 from 1024^2
+    to 4096^2 on TPU)."""
+    iters = []
+    for level in (3, 5):  # 64^2 -> 256^2
+        g = _grid(level=level)
+        x, y = g.cell_centers()
+        rng = np.random.default_rng(3)
+        raw = np.sin(3 * np.pi * x) * np.cos(2 * np.pi * y) \
+            + 0.3 * rng.standard_normal(x.shape)
+        b = jnp.asarray(raw - raw.mean())
+        res = bicgstab(g.laplacian, b, M=g.mg, tol=0.0, tol_rel=1e-6,
+                       max_iter=200)
+        assert bool(res.converged)
+        iters.append(int(res.iters))
+    assert iters[1] <= iters[0] + 3, f"MG iters grew: {iters}"
+
+
+def test_multigrid_f32_production_path():
+    """f32 + bf16-cycle MG (the TPU production configuration) still
+    converges to the reference production tolerance."""
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=1, level_start=0,
+                    extent=1.0, dtype="float32")
+    g = UniformGrid(cfg, level=5)  # 256^2
+    rng = np.random.default_rng(11)
+    raw = rng.standard_normal((g.ny, g.nx)).astype(np.float32)
+    b = jnp.asarray(raw - raw.mean(), jnp.float32)
+    res = bicgstab(g.laplacian, b, M=g.mg, tol=1e-3,
+                   tol_rel=1e-2, max_iter=200)
+    assert bool(res.converged)
+    true_r = float(jnp.max(jnp.abs(b - g.laplacian(res.x))))
+    assert true_r <= 1.5 * max(1e-3, 1e-2 * float(jnp.max(jnp.abs(b))))
