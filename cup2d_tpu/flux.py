@@ -1,0 +1,367 @@
+"""Coarse-fine conservation: the makeFlux Poisson closure + flux correction.
+
+Two pieces the reference treats as correctness invariants at AMR level
+interfaces, re-expressed as gather tables / index tables so the per-step
+device work stays branch-free:
+
+1. **Variable-resolution Poisson closure** (`/root/reference/main.cpp:
+   5916-5997` interpolate/makeFlux/D1/D2, assembled into COO rows at
+   `7031-7115`). The reference builds one sparse row per cell; every row
+   is "sum over the 4 faces of (ghost - this)" where the ghost at a
+   level interface is the 8/15, 2/3, -1/5 interpolation with D1/D2
+   tangential Taylor corrections (fine side) or the flux-replacement sum
+   over the two fine subfaces (coarse side). Both are LINEAR in stored
+   cell values, so the whole operator is `laplacian5` applied to a lab
+   whose interface ghosts encode those rows — built here as a drop-in
+   builder for `halo.build_tables`. The resulting operator is exactly
+   the reference's matrix: consistent, and conservative (the flux a fine
+   cell pair sees is minus the flux the coarse cell sees, D-terms
+   included — the D1 terms cancel pairwise across a subface pair).
+
+2. **Flux correction for stencil kernels** (`main.cpp:513-517 BlockCase,
+   1392-1849 prepare0/fillcases`). Reference kernels deposit each
+   block-face's *linear* flux (diffusive flux for advection-diffusion,
+   face velocity for the divergence RHS, pressure gradient for the
+   projection; the WENO advective part is never corrected) into per-face
+   stores; `fillcases` then ADDS [own coarse deposit + paired sums of
+   the fine deposits] to the coarse edge cells, which — because a
+   deposit is defined as minus the face's contribution to the written
+   value — replaces the coarse face's term with minus the fine side's:
+   discrete conservation. Here every block computes its 4 face-deposit
+   vectors from the already-assembled labs (vectorized over all blocks),
+   and a topology-only index table (built once per regrid) gathers
+   [coarse deposit + fine pair] into the affected cells.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .forest import Forest
+from .halo import Expr, HaloTables, build_tables
+
+# face order = the reference's BlockCase d[0..3] (main.cpp:513-517)
+_FACES = ((-1, 0), (1, 0), (0, -1), (0, 1))  # Xm, Xp, Ym, Yp
+
+
+# ---------------------------------------------------------------------------
+# 1. Poisson closure as a lab-ghost builder
+# ---------------------------------------------------------------------------
+
+# D1/D2 tangential stencils at a coarse cell (main.cpp:5916-5959): keyed
+# by (is_backward, is_forward); offsets are tangential steps within the
+# coarse block. The BS/2 splits keep the stencil inside the half-face a
+# single fine block abuts.
+_D1 = {
+    "bd": ((-2, 1.0 / 8.0), (-1, -1.0 / 2.0), (0, 3.0 / 8.0)),
+    "fd": ((2, -1.0 / 8.0), (1, 1.0 / 2.0), (0, -3.0 / 8.0)),
+    "ct": ((-1, -1.0 / 8.0), (1, 1.0 / 8.0)),
+}
+_D2 = {
+    "bd": ((-2, 1.0 / 32.0), (-1, -1.0 / 16.0), (0, 1.0 / 32.0)),
+    "fd": ((2, 1.0 / 32.0), (1, -1.0 / 16.0), (0, 1.0 / 32.0)),
+    "ct": ((-1, 1.0 / 32.0), (1, 1.0 / 32.0), (0, -1.0 / 16.0)),
+}
+
+
+def _dkind(t: int, bs: int) -> str:
+    if t == bs - 1 or t == bs // 2 - 1:
+        return "bd"
+    if t == 0 or t == bs // 2:
+        return "fd"
+    return "ct"
+
+
+def _fine_subface(cx: int, cy: int, l: int, bi: int, bj: int, t: int,
+                  bs: int):
+    """For coarse block (l, bi, bj), face (cx, cy), face cell t: the
+    finer neighbor block key covering that cell and the tangential index
+    of the first of its two subface cells (the reference's Zchild +
+    neiFine1/neiFine2 addressing, main.cpp:5825-5914). Shared by the
+    Poisson closure and the flux-correction table so the two stay
+    index-consistent by construction."""
+    half = 1 if t >= bs // 2 else 0
+    if cx != 0:
+        a = 1 if cx < 0 else 0
+        fb = (l + 1, 2 * (bi + cx) + a, 2 * bj + half)
+    else:
+        b_ = 1 if cy < 0 else 0
+        fb = (l + 1, 2 * bi + half, 2 * (bj + cy) + b_)
+    return fb, 2 * (t % (bs // 2))
+
+
+class _PoissonLabBuilder:
+    """Ghost expressions making `laplacian5(lab)` the reference's
+    variable-resolution Poisson operator. Same constructor/`block_ghosts`
+    contract as `halo._LabBuilder` so `build_tables` grouping reuses it.
+    """
+
+    def __init__(self, forest, g: int, tensorial: bool, dim: int):
+        assert g == 1 and dim == 1
+        self.f = forest
+        self.bs = forest.bs
+        self.g = 1
+        self.dim = 1
+
+    def _cell(self, slot, cy, cx, w=1.0):
+        return Expr({(slot, cy, cx): np.full(1, w)})
+
+    def _tang(self, slot, edge_n, tc, table, xface: bool) -> Expr:
+        """D1/D2 expression at coarse cell (normal index edge_n,
+        tangential index tc), tangential steps within block `slot`."""
+        e = Expr()
+        for d, w in table[_dkind(tc, self.bs)]:
+            cy, cx = (tc + d, edge_n) if xface else (edge_n, tc + d)
+            e.add(self._cell(slot, cy, cx), w)
+        return e
+
+    def block_ghosts(self, slot: int):
+        f = self.f
+        bs = self.bs
+        l = int(f.level[slot])
+        bi = int(f.bi[slot])
+        bj = int(f.bj[slot])
+        nbx, nby = f.nblocks_at(l)
+        out: dict[tuple[int, int], Expr] = {}
+
+        for face, (cx, cy) in enumerate(_FACES):
+            xface = cx != 0
+            ni, nj = bi + cx, bj + cy
+            wall = not (0 <= ni < nbx and 0 <= nj < nby)
+            # own edge coords along the face, as (cy, cx) builders
+            edge_n = (0 if cx < 0 else bs - 1) if xface else \
+                     (0 if cy < 0 else bs - 1)
+
+            def own(t, depth=0):
+                n = edge_n + (1 if (cx < 0 or cy < 0) else -1) * depth
+                return (t, n) if xface else (n, t)
+
+            def lab_of(t):
+                if xface:
+                    lx = 0 if cx < 0 else bs + 1
+                    return (t + 1, lx)
+                ly = 0 if cy < 0 else bs + 1
+                return (ly, t + 1)
+
+            if wall:
+                # zero-Neumann wall: ghost = edge cell, flux = 0
+                # (the reference skips boundary faces entirely,
+                # main.cpp:7104 isBoundary)
+                for t in range(bs):
+                    oy, ox = own(t)
+                    out[lab_of(t)] = self._cell(slot, oy, ox)
+                continue
+
+            rel = f.owner_relation(l, ni, nj)
+            if rel == 0:
+                ns = f.slot(l, ni, nj)
+                n_edge = (bs - 1 if cx < 0 else 0) if xface else \
+                         (bs - 1 if cy < 0 else 0)
+                for t in range(bs):
+                    cyx = (t, n_edge) if xface else (n_edge, t)
+                    out[lab_of(t)] = self._cell(ns, *cyx)
+            elif rel == -2:
+                # fine side of a fine-coarse interface: interpolated
+                # ghost (interpolate(), signInt=+1, main.cpp:5943-5960)
+                cs = f.slot(l - 1, ni // 2, nj // 2)
+                assert cs >= 0
+                c_edge = (bs - 1 if cx < 0 else 0) if xface else \
+                         (bs - 1 if cy < 0 else 0)
+                par = (bj & 1) if xface else (bi & 1)
+                for t in range(bs):
+                    tc = t // 2 + par * (bs // 2)
+                    ccyx = (tc, c_edge) if xface else (c_edge, tc)
+                    st = -1.0 if t % 2 == 0 else 1.0
+                    e = Expr()
+                    e.add(self._cell(slot, *own(t)), 2.0 / 3.0)
+                    e.add(self._cell(slot, *own(t, 1)), -1.0 / 5.0)
+                    e.add(self._cell(cs, *ccyx), 8.0 / 15.0)
+                    e.add(self._tang(cs, c_edge, tc, _D1, xface),
+                          st * 8.0 / 15.0)
+                    e.add(self._tang(cs, c_edge, tc, _D2, xface),
+                          8.0 / 15.0)
+                    out[lab_of(t)] = e
+            elif rel == -1:
+                # coarse side: flux replacement by the two fine subfaces
+                # (makeFlux -1 branch; the paired D1 terms cancel,
+                # leaving -16/15 D2, main.cpp:5997-6013)
+                fe_close = bs - 1 if (cx < 0 or cy < 0) else 0
+                fe_far = fe_close + (-1 if fe_close == bs - 1 else 1)
+                for t in range(bs):
+                    fb, tf0 = _fine_subface(cx, cy, l, bi, bj, t, bs)
+                    fs = f.slot(*fb)
+                    assert fs >= 0
+                    e = Expr()
+                    e.add(self._cell(slot, *own(t)), 1.0 - 16.0 / 15.0)
+                    for tf in (tf0, tf0 + 1):
+                        ccyx = (tf, fe_close) if xface else (fe_close, tf)
+                        fcyx = (tf, fe_far) if xface else (fe_far, tf)
+                        e.add(self._cell(fs, *ccyx), 1.0 / 3.0)
+                        e.add(self._cell(fs, *fcyx), 1.0 / 5.0)
+                    e.add(self._tang(slot, edge_n, t, _D2, xface),
+                          -16.0 / 15.0)
+                    out[lab_of(t)] = e
+            else:  # pragma: no cover - 2:1 balance guarantees a neighbor
+                raise AssertionError("missing neighbor on balanced forest")
+        return out
+
+
+def build_poisson_tables(forest: Forest, order: np.ndarray) -> HaloTables:
+    """g=1 scalar tables: `laplacian5(assemble_labs_ordered(x, t), 1)`
+    is the reference's variable-resolution Poisson matrix A."""
+    return build_tables(forest, order, 1, False, 1,
+                        builder_cls=_PoissonLabBuilder)
+
+
+# ---------------------------------------------------------------------------
+# 2. Flux-correction index tables + per-kernel face deposits
+# ---------------------------------------------------------------------------
+
+class FluxCorrTables(NamedTuple):
+    """Correction rows: value[dest] += D[cidx] + D[fidx1] + D[fidx2],
+    where D is a [n_active * 4 * BS, dim] face-deposit array. One row per
+    coarse edge cell whose face abuts a finer neighbor (the reference's
+    fillcase0+fillcase1 combination)."""
+
+    dest: jnp.ndarray    # [M] into ordered cell layout [n_active*BS*BS]
+    cidx: jnp.ndarray    # [M] coarse block's own face deposit
+    fidx1: jnp.ndarray   # [M] fine subface deposits (the pair)
+    fidx2: jnp.ndarray   # [M]
+    n_active: int
+    bs: int
+
+
+jax.tree_util.register_pytree_node(
+    FluxCorrTables,
+    lambda t: ((t.dest, t.cidx, t.fidx1, t.fidx2), (t.n_active, t.bs)),
+    lambda aux, ch: FluxCorrTables(*ch, *aux),
+)
+
+
+def build_flux_corr(forest: Forest, order: np.ndarray) -> FluxCorrTables:
+    """Topology-only; shared by every corrected kernel (the per-kernel
+    physics lives in the deposit arrays)."""
+    bs = forest.bs
+    ordpos = {int(s): k for k, s in enumerate(order)}
+    dest, cidx, f1, f2 = [], [], [], []
+    for k, s in enumerate(order):
+        l = int(forest.level[s])
+        bi = int(forest.bi[s])
+        bj = int(forest.bj[s])
+        nbx, nby = forest.nblocks_at(l)
+        for face, (cx, cy) in enumerate(_FACES):
+            ni, nj = bi + cx, bj + cy
+            if not (0 <= ni < nbx and 0 <= nj < nby):
+                continue
+            if forest.owner_relation(l, ni, nj) != -1:
+                continue
+            opp = face ^ 1
+            for t in range(bs):
+                fb, tf0 = _fine_subface(cx, cy, l, bi, bj, t, bs)
+                if cx != 0:
+                    cell = t * bs + (0 if face == 0 else bs - 1)
+                else:
+                    cell = (0 if face == 2 else bs - 1) * bs + t
+                kf = ordpos[forest.blocks[fb]]
+                dest.append(k * bs * bs + cell)
+                cidx.append((k * 4 + face) * bs + t)
+                f1.append((kf * 4 + opp) * bs + tf0)
+                f2.append((kf * 4 + opp) * bs + tf0 + 1)
+    as_i = lambda a: jnp.asarray(np.asarray(a, np.int32))
+    return FluxCorrTables(
+        dest=as_i(dest), cidx=as_i(cidx), fidx1=as_i(f1), fidx2=as_i(f2),
+        n_active=len(order), bs=bs,
+    )
+
+
+def apply_flux_corr(values: jnp.ndarray, deposits: jnp.ndarray,
+                    t: FluxCorrTables) -> jnp.ndarray:
+    """values: [N, BS, BS] or [N, dim, BS, BS] kernel output (ordered);
+    deposits: [N, 4, BS] or [N, 4, BS, dim] from a `*_deposits` helper.
+    Returns corrected values (the reference's fillcases add)."""
+    if values.ndim == 3:
+        flat = values.reshape(-1)
+        d = deposits.reshape(-1)
+        corr = d[t.cidx] + d[t.fidx1] + d[t.fidx2]
+        return flat.at[t.dest].add(corr).reshape(values.shape)
+    n, dim, bs, _ = values.shape
+    flat = values.transpose(0, 2, 3, 1).reshape(-1, dim)
+    d = deposits.reshape(-1, dim)
+    corr = d[t.cidx] + d[t.fidx1] + d[t.fidx2]
+    out = flat.at[t.dest].add(corr)
+    return out.reshape(n, bs, bs, dim).transpose(0, 3, 1, 2)
+
+
+def _face_pairs(lab: jnp.ndarray, g: int, bs: int):
+    """(this, ghost) slices per face of [..., L, L] labs; the face axis
+    runs along the block edge (length BS)."""
+    return (
+        (lab[..., g:g + bs, g], lab[..., g:g + bs, g - 1]),        # Xm
+        (lab[..., g:g + bs, g + bs - 1], lab[..., g:g + bs, g + bs]),  # Xp
+        (lab[..., g, g:g + bs], lab[..., g - 1, g:g + bs]),        # Ym
+        (lab[..., g + bs - 1, g:g + bs], lab[..., g + bs, g:g + bs]),  # Yp
+    )
+
+
+def diffusive_deposits(vlab: jnp.ndarray, g: int, dfac) -> jnp.ndarray:
+    """KernelAdvectDiffuse deposits (main.cpp:5504-5570): dfac*(this -
+    ghost) per component; only the diffusive flux is corrected, the WENO
+    advective term is not. vlab [N, 2, L, L] -> [N, 4, BS, 2]."""
+    bs = vlab.shape[-1] - 2 * g
+    rows = [dfac * (t - gh) for (t, gh) in _face_pairs(vlab, g, bs)]
+    return jnp.stack(rows, axis=1).transpose(0, 1, 3, 2)  # [N,4,BS,2]
+
+
+def divergence_deposits(vlab: jnp.ndarray, ulab, chi, facDiv) -> jnp.ndarray:
+    """pressure_rhs deposits (main.cpp:6152-6207): +-facDiv*(vn_this +
+    vn_ghost) minus the chi*udef counterpart; vn is the face-normal
+    component. facDiv = 0.5*h/dt per block, shaped [N] (or scalar).
+    vlab/ulab [N, 2, L, L], chi [N, BS, BS] -> [N, 4, BS]."""
+    g = 1
+    bs = vlab.shape[-1] - 2
+    fd = jnp.asarray(facDiv)
+    fd = fd.reshape(-1, 1) if fd.ndim else fd
+    pairs = _face_pairs(vlab, g, bs)
+    upairs = _face_pairs(ulab, g, bs) if ulab is not None else None
+    chi_edge = (chi[:, :, 0], chi[:, :, bs - 1],
+                chi[:, 0, :], chi[:, bs - 1, :]) if chi is not None else None
+    rows = []
+    for f in range(4):
+        comp = 0 if f < 2 else 1
+        sgn = 1.0 if f % 2 == 0 else -1.0
+        t, gh = pairs[f]
+        val = t[:, comp] + gh[:, comp]
+        if upairs is not None:
+            ut, ugh = upairs[f]
+            val = val - chi_edge[f] * (ut[:, comp] + ugh[:, comp])
+        rows.append(sgn * fd * val)
+    return jnp.stack(rows, axis=1)
+
+
+def laplacian_deposits(plab: jnp.ndarray) -> jnp.ndarray:
+    """pressure_rhs1 deposits (main.cpp:6231-6286): ghost - this per
+    face. plab [N, L, L] -> [N, 4, BS]."""
+    bs = plab.shape[-1] - 2
+    return jnp.stack(
+        [gh - t for (t, gh) in _face_pairs(plab, 1, bs)], axis=1)
+
+
+def gradient_deposits(plab: jnp.ndarray, pfac) -> jnp.ndarray:
+    """pressureCorrectionKernel deposits (main.cpp:6055-6103):
+    +-pfac*(this + ghost) in the face-normal component only; pfac =
+    -0.5*dt*h per block [N]. plab [N, L, L] -> [N, 4, BS, 2]."""
+    bs = plab.shape[-1] - 2
+    pf = jnp.asarray(pfac)
+    pf = pf.reshape(-1, 1) if pf.ndim else pf
+    out = []
+    for f, (t, gh) in enumerate(_face_pairs(plab, 1, bs)):
+        sgn = 1.0 if f % 2 == 0 else -1.0
+        val = sgn * pf * (t + gh)
+        zero = jnp.zeros_like(val)
+        out.append(jnp.stack([val, zero] if f < 2 else [zero, val],
+                             axis=-1))
+    return jnp.stack(out, axis=1)  # [N, 4, BS, 2]

@@ -159,7 +159,7 @@ def _block_rows(forest, builder, s, ordpos, L, bs, dim):
 
 
 def build_tables(forest: Forest, order: np.ndarray, g: int,
-                 tensorial: bool, dim: int) -> HaloTables:
+                 tensorial: bool, dim: int, builder_cls=None) -> HaloTables:
     """Build gather tables for all ghost cells of all active blocks.
 
     The expression builder is O(ghost cells x interpolation depth) of
@@ -175,7 +175,13 @@ def build_tables(forest: Forest, order: np.ndarray, g: int,
     can't see — those fall back to the naive path), and instantiation
     is a numpy role->slot gather. Typical adapted forests have tens of
     distinct patterns across thousands of blocks.
+
+    ``builder_cls`` swaps the ghost-expression specification: the
+    default `_LabBuilder` is the reference BlockLab; `flux.py` passes a
+    builder producing the makeFlux variable-resolution Poisson ghosts
+    (same (forest, g, tensorial, dim) constructor + `block_ghosts`).
     """
+    builder_cls = builder_cls or _LabBuilder
     bs = forest.bs
     L = bs + 2 * g
     n_act = len(order)
@@ -196,7 +202,7 @@ def build_tables(forest: Forest, order: np.ndarray, g: int,
                bj == nby - 1, rels)
         groups.setdefault(key, []).append(ordpos)
 
-    naive = _LabBuilder(forest, g, tensorial, dim)
+    naive = builder_cls(forest, g, tensorial, dim)
     # accumulators: simple rows (dest, src, sign) / general rows
     sd_parts, ss_parts, sg_parts = [], [], []
     gd_parts, gi_parts, gw_parts = [], [], []
@@ -250,7 +256,7 @@ def build_tables(forest: Forest, order: np.ndarray, g: int,
         rep = members[0]
         s0, l0, bi0, bj0 = meta[rep]
         rec = _RecordingForest(forest, l0, bi0, bj0)
-        exprs = _LabBuilder(rec, g, tensorial, dim).block_ghosts(s0)
+        exprs = builder_cls(rec, g, tensorial, dim).block_ghosts(s0)
         (roles, s_dest, s_role, s_cell, s_sign,
          g_dest, role_m, cell_m, w_m, valid) = classify_template(
             exprs, l0, bi0, bj0)
