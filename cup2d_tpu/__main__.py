@@ -7,13 +7,13 @@ names (`/root/reference/main.cpp:6306-6341`, `run.sh:1-22`): e.g.
         -Rtol 2 -Ctol 1 -extent 4 -CFL 0.5 -tend 10 -lambda 1e7 \
         -nu 0.00004 -poissonTol 1e-3 -poissonTolRel 0.01 \
         -maxPoissonRestarts 0 -maxPoissonIterations 1000 -AdaptSteps 20 \
-        -tdump 0.5 -shapes 'angle=0,L=0.2,xpos=1.8,ypos=0.8
-                            angle=180,L=0.2,xpos=1.6,ypos=0.8'
+        -tdump 0.5 -shapes 'angle=0 L=0.2 xpos=1.8 ypos=0.8
+                            angle=180 L=0.2 xpos=1.6 ypos=0.8'
 
-Extra flags beyond the reference: ``-level N`` (uniform run at level N —
-until the AMR path lands this selects the single resolution), ``-dtype``,
-``-output DIR``, ``-checkpointEvery N``, ``-restart DIR``,
-``-maxSteps N``.
+By default this executes the adaptive (AMR) path, exactly like the
+reference. Extra flags beyond the reference: ``-level N`` (force a
+single-resolution uniform run at level N), ``-dtype``, ``-output DIR``,
+``-checkpointEvery N``, ``-restart DIR``, ``-maxSteps N``.
 """
 
 from __future__ import annotations
@@ -22,22 +22,44 @@ import os
 import sys
 
 from .config import CommandlineParser, SimConfig
-from .io import dump_uniform, load_checkpoint, save_checkpoint
-from .sim import Simulation
+from .io import dump_forest, dump_uniform, load_checkpoint, save_checkpoint
+
+
+def enable_compilation_cache():
+    """Persistent XLA compilation cache: adaptive runs compile one
+    executable per (bucket, window-capacity) combination — tens of
+    multi-second TPU compiles that are identical across process
+    restarts of the same case."""
+    import jax
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("CUP2D_CACHE",
+                           os.path.expanduser("~/.cache/cup2d_tpu_xla")))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without the knob: run uncached
 
 
 def main(argv=None) -> int:
+    enable_compilation_cache()
     argv = sys.argv[1:] if argv is None else argv
     p = CommandlineParser(argv)
     cfg = SimConfig.from_argv(argv)
-    level = p("level").asInt() if p.has("level") else cfg.level_start
+    uniform = p.has("level") or cfg.level_max <= 1
     outdir = p("output").asString() if p.has("output") else "."
     ckpt_every = p("checkpointEvery").asInt() if p.has("checkpointEvery") \
         else 0
     max_steps = p("maxSteps").asInt() if p.has("maxSteps") else 10**9
     os.makedirs(outdir, exist_ok=True)
 
-    sim = Simulation(cfg, level=level)
+    if uniform:
+        from .sim import Simulation
+        level = p("level").asInt() if p.has("level") else cfg.level_start
+        sim = Simulation(cfg, level=level)
+    else:
+        from .amr import AMRSim
+        sim = AMRSim(cfg)
     if p.has("restart"):
         load_checkpoint(p("restart").asString(), sim)
 
@@ -45,7 +67,7 @@ def main(argv=None) -> int:
     resuming = p.has("restart") and os.path.exists(force_path)
     sim.force_log = open(force_path, "a" if resuming else "w")
     if not resuming:
-        sim.force_log.write(Simulation.force_log_header() + "\n")
+        sim.force_log.write(type(sim).force_log_header() + "\n")
 
     if sim.shapes and not p.has("restart"):
         # t=0 only: the chi-blend vel = vel(1-chi) + udef*chi would
@@ -53,6 +75,12 @@ def main(argv=None) -> int:
         # velocity and silently fork the resumed trajectory (ADVICE.md
         # r1); load_checkpoint already marks the sim initialized.
         sim.initialize()   # so the t=0 dump sees the blended velocity
+
+    def dump(path):
+        if uniform:
+            dump_uniform(path, sim.time, sim.state.vel, sim.grid.h)
+        else:
+            dump_forest(path, sim.time, sim.forest)
 
     next_dump = sim.time if cfg.dump_time > 0 else float("inf")
     while sim.time < cfg.end_time and sim.step_count < max_steps:
@@ -64,8 +92,10 @@ def main(argv=None) -> int:
             # falls permanently behind there, main.cpp:6597-6602)
             while next_dump <= sim.time:
                 next_dump += cfg.dump_time
-            path = os.path.join(outdir, f"vel.{sim.step_count:08d}")
-            dump_uniform(path, sim.time, sim.state.vel, sim.grid.h)
+            dump(os.path.join(outdir, f"vel.{sim.step_count:08d}"))
+        if not uniform and (sim.step_count <= 10
+                            or sim.step_count % cfg.adapt_steps == 0):
+            sim.adapt()
         diag = sim.step_once()
         if float(diag.get("umax", 0.0)) != float(diag.get("umax", 0.0)):
             print("cup2d_tpu: NaN velocity, aborting", file=sys.stderr)

@@ -5,28 +5,36 @@ adapt() 4657-5440 + the hot loop 6576-7290) with the TPU split:
 
 host (numpy, per regrid)         device (jit, per step)
 ------------------------------   --------------------------------------
-tagging decisions + 2:1 sweeps   vorticity for tags (lab + kernel)
+tagging decisions + 2:1 sweeps   vorticity + chi tags (lab + kernel)
 slot alloc/release, SFC order    WENO5 advection-diffusion RK2 over all
 halo gather-table rebuild          blocks at once (per-block h arrays)
+window block selection           SDF/udef rasterization into blocks,
+                                   chi, integrals, penalization solve,
+                                   collisions (ops/obstacle, collision)
                                  prolongation / restriction batches
                                  matrix-free BiCGSTAB on the forest
-                                   (lab-assembled variable-resolution
-                                   Laplacian + block-Jacobi GEMM)
-
-Jitted functions are keyed by n_active so a regrid that changes the
-block count triggers exactly one recompile for the new shape (the
-reference rebuilds its MPI synchronizer plans at the same point,
-main.cpp:5425-5437).
+                                   (makeFlux variable-resolution rows +
+                                   block-Jacobi GEMM)
 
 Level interfaces are discretely conservative: the Poisson operator uses
 the makeFlux variable-resolution closure and the stencil kernels carry
-coarse-fine flux correction (both in flux.py). Not yet on the forest
-path: obstacles (uniform-grid Simulation covers them).
+coarse-fine flux correction (both in flux.py).
+
+Obstacles live on the forest exactly as the reference's ongrid() does
+(main.cpp:3991-4630): blocks intersecting a body's bounding box are
+selected on the host (padded to a static capacity so the moving body
+never retriggers compilation), the device rasterizes SDF/udef per block
+at that block's own resolution, chi comes from the combined-SDF lab, and
+the chi field drives GradChiOnTmp-style refinement (main.cpp:4631-4656)
+so the body is always surrounded by finest-level blocks.
+
+Jitted functions take tables/order/h as arguments, so regrids that
+reproduce previously-seen shapes hit the XLA compile cache.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -36,21 +44,57 @@ from .config import SimConfig
 from .flux import apply_flux_corr, build_flux_corr, build_poisson_tables, \
     diffusive_deposits, divergence_deposits, gradient_deposits
 from .forest import Forest
-from .halo import assemble_labs, assemble_labs_ordered, build_tables
+from .halo import assemble_labs, assemble_labs_ordered, build_tables, \
+    pad_tables
+from .ops.collision import collision_response, overlap_integrals
+from .ops.forces import surface_forces_blocks
+from .ops.obstacle import (
+    chi_from_sdf,
+    midline_udef,
+    penalization_integrals,
+    polygon_sdf,
+    shape_integrals,
+    solve_rigid_momentum,
+)
 from .ops.stencil import advect_diffuse_rhs, divergence, laplacian5, \
     pressure_gradient_update, vorticity
 from .poisson import apply_block_precond_blocks, bicgstab, \
     block_precond_matrix
+from .shapes_host import ShapeHostMixin
 
 
-class AMRSim:
-    """Adaptive obstacle-free flow solver on the block forest."""
+class ObstacleForestFields(NamedTuple):
+    """Per-step obstacle state on the forest, in SFC-ordered block layout
+    (the reference's per-shape Obstacle blocks + global chi/tmp grids,
+    main.cpp:3283-3342). Vector fields are component-first so the shared
+    penalization/collision kernels (which index [0]/[1]) apply
+    unchanged."""
 
-    def __init__(self, cfg: SimConfig):
+    chi: jnp.ndarray      # [N, BS, BS] combined (max over shapes)
+    sdf: jnp.ndarray      # [N, BS, BS] combined signed distance
+    chi_s: jnp.ndarray    # [S, N, BS, BS]
+    sdf_s: jnp.ndarray    # [S, N, BS, BS]
+    udef_s: jnp.ndarray   # [S, 2, N, BS, BS] de-meaned deformation vel
+    com: jnp.ndarray      # [S, 2] chi-corrected centers of mass
+    mass: jnp.ndarray     # [S]
+    inertia: jnp.ndarray  # [S]
+
+
+class AMRSim(ShapeHostMixin):
+    """Adaptive flow solver on the block forest, with or without
+    immersed obstacles (the reference's only mode is 'with')."""
+
+    def __init__(self, cfg: SimConfig, shapes: Optional[Sequence] = None):
         self.cfg = cfg
+        if shapes is None:
+            from .sim import make_shapes
+            shapes = make_shapes(cfg)
+        self.shapes = list(shapes)
         self.forest = Forest(cfg)
         self.forest.add_field("vel", 2)
         self.forest.add_field("pres", 1)
+        if self.shapes:
+            self.forest.add_field("chi", 1)
         self.time = 0.0
         self.step_count = 0
         self.p_inv = jnp.asarray(
@@ -66,12 +110,20 @@ class AMRSim:
         self._tables_version = -1
         self._tables = {}
         self._order = None
+        self._wcap = [16] * len(self.shapes)
+        self.compute_forces_every = 1   # 0 disables the diagnostics pass
+        self.force_log = None           # file-like, CSV rows
         # jitted ONCE; tables/order/h are arguments, so regrids that
         # reproduce previously-seen shapes hit the XLA compile cache
         self._step_jit = jax.jit(
             self._step_impl, static_argnames=("exact_poisson",))
+        self._flow_jit = jax.jit(
+            self._flow_impl, static_argnames=("exact_poisson",))
+        self._raster_jit = jax.jit(self._rasterize_impl)
         self._vorticity_jit = jax.jit(self._vorticity_impl)
+        self._chi_tag_jit = jax.jit(self._chi_tag_impl)
         self._prolong_jit = jax.jit(self._prolong_impl)
+        self._forces_jit = jax.jit(self._forces_impl)
 
     # ------------------------------------------------------------------
     # topology-dependent cached state
@@ -81,34 +133,78 @@ class AMRSim:
         if self._tables_version == f.version:
             return
         self._order = f.order()
+        n_real = len(self._order)
+        # block axis padded to power-of-two buckets so a regrid that
+        # changes n_active reuses the compiled step (SURVEY §7: padded
+        # capacity + masking discipline; the r1 per-count retrace made
+        # every regrid recompile a Krylov loop). Strictly > n_real so
+        # pad-row lab/cell slots exist as dead scatter targets for the
+        # shape-stable table padding (halo.pad_tables). Pad rows point
+        # at an inactive slot: gathers see stale-but-finite data that
+        # the mask zeroes, scatters write garbage only to that slot.
+        n_pad = max(128, 1 << n_real.bit_length())
+        if not f._free:
+            f._grow()
+        pad_slot = f._free[-1]
+        order_p = np.concatenate([
+            self._order,
+            np.full(n_pad - n_real, pad_slot, np.int32)])
+        self._n_real = n_real
+        self._mask = np.arange(n_pad) < n_real
+
+        def padded(t):
+            return pad_tables(t, n_pad)
+
         self._tables = {
-            "vec3": build_tables(f, self._order, 3, True, 2),
-            "vec1": build_tables(f, self._order, 1, False, 2),
-            "sca1": build_tables(f, self._order, 1, False, 1),
-            "vec1t": build_tables(f, self._order, 1, True, 2),
-            "sca1t": build_tables(f, self._order, 1, True, 1),
+            "vec3": padded(build_tables(f, self._order, 3, True, 2)),
+            "vec1": padded(build_tables(f, self._order, 1, False, 2)),
+            "sca1": padded(build_tables(f, self._order, 1, False, 1)),
+            "vec1t": padded(build_tables(f, self._order, 1, True, 2)),
+            "sca1t": padded(build_tables(f, self._order, 1, True, 1)),
             # makeFlux variable-resolution Poisson rows (flux.py)
-            "pois": build_poisson_tables(f, self._order),
+            "pois": padded(build_poisson_tables(f, self._order)),
         }
-        self._corr = build_flux_corr(f, self._order)
+        if self.shapes:
+            # chi tagging (g=4 scalar) + force diagnostics (g=4 vector)
+            self._tables["sca4t"] = padded(
+                build_tables(f, self._order, 4, True, 1))
+            self._tables["vec4t"] = padded(
+                build_tables(f, self._order, 4, True, 2))
+        self._corr = build_flux_corr(f, self._order, n_pad=n_pad)
         h = f.h_per_block(self._order)
-        self._h = jnp.asarray(h, f.dtype)[:, None, None, None]
-        self._hsq_flat = jnp.asarray(h * h, f.dtype)[:, None, None]
-        self._order_j = jnp.asarray(self._order)
+        hp = np.concatenate([h, np.ones(n_pad - n_real)])
+        hsqp = np.concatenate([h * h, np.zeros(n_pad - n_real)])
+        self._h = jnp.asarray(hp, f.dtype)[:, None, None, None]
+        self._h3 = self._h[:, 0]
+        self._hflat = jnp.asarray(hp, f.dtype)
+        self._hsq_flat = jnp.asarray(hsqp, f.dtype)[:, None, None]
+        self._maskv = jnp.asarray(self._mask, f.dtype)[:, None, None, None]
+        self._order_j = jnp.asarray(order_p)
+        # cell centers per active block (device, for obstacle kernels)
+        bs = f.bs
+        ar = np.arange(bs) + 0.5
+        x0 = f.bi[self._order].astype(np.float64) * bs * h
+        y0 = f.bj[self._order].astype(np.float64) * bs * h
+        xc = np.zeros((n_pad, bs, bs))
+        yc = np.zeros((n_pad, bs, bs))
+        xc[:n_real] = x0[:, None, None] + ar[None, None, :] * h[:, None, None]
+        yc[:n_real] = y0[:, None, None] + ar[None, :, None] * h[:, None, None]
+        self._xc = jnp.asarray(xc, f.dtype)
+        self._yc = jnp.asarray(yc, f.dtype)
         self._tables_version = f.version
 
     # ------------------------------------------------------------------
-    # device step (jitted per topology)
+    # shared device stages
     # ------------------------------------------------------------------
-    def _step_impl(self, vel, pres, dt, order, h, hsq, t3, t1v, t1s,
-                   tpois, corr, exact_poisson=False):
+    def _advect_rk2(self, vel, order, h, dt, t3, corr, maskv):
+        """Heun RK2 advection-diffusion (per-block h); diffusive face
+        fluxes flux-corrected at level interfaces (fillcases after each
+        stage, main.cpp:6607-6642). Returns updated ordered blocks.
+        ``maskv`` zeroes the padded rows each stage (pad-slot data is
+        stale, never NaN — see _refresh)."""
         cfg = self.cfg
         ih2 = 1.0 / (h * h)
-
-        # Heun RK2 advection-diffusion (per-block h); the diffusive face
-        # fluxes are flux-corrected at level interfaces (the reference's
-        # fillcases after each stage, main.cpp:6607-6642)
-        vold = vel[order]                # [N,2,BS,BS]
+        vold = vel[order] * maskv        # [N,2,BS,BS]
         v = vold
         for c in (0.5, 1.0):
             lab = assemble_labs(
@@ -116,19 +212,30 @@ class AMRSim:
             rhs = advect_diffuse_rhs(lab, 3, h, cfg.nu, dt)
             rhs = apply_flux_corr(
                 rhs, diffusive_deposits(lab, 3, cfg.nu * dt), corr)
-            v = vold + c * rhs * ih2
+            v = (vold + c * rhs * ih2) * maskv
+        return v
 
-        # Poisson in deltap form on the forest; the RHS divergence is
-        # flux-corrected, and the operator (also applied to the initial
-        # guess p_old) is the makeFlux variable-resolution closure —
-        # conservative on both sides of every interface
-        pord = pres[order][:, 0]         # [N,BS,BS]
+    def _pressure_project(self, vel, v, pres, dt, order, h, hsq,
+                          t1v, t1s, tpois, corr, exact_poisson, maskv,
+                          chi=None, udef_b=None):
+        """deltap Poisson solve + projection (main.cpp:7007-7187). The
+        RHS divergence is flux-corrected; the operator (also applied to
+        the initial guess p_old) is the makeFlux variable-resolution
+        closure — conservative on both sides of every interface.
+        ``chi``/``udef_b`` add the -chi div(u_def) obstacle term."""
+        cfg = self.cfg
+        ih2 = 1.0 / (h * h)
+        pord = pres[order][:, 0] * maskv[:, 0]   # [N,BS,BS]
         vel_full = vel.at[order].set(v)
         vlab = assemble_labs(vel_full, order, t1v)
         fac = 0.5 * h[:, 0] / dt
         b = fac * divergence(vlab, 1)
+        ulab = None
+        if udef_b is not None:
+            ulab = assemble_labs_ordered(udef_b, t1v)
+            b = b - fac * chi * divergence(ulab, 1)
         b = apply_flux_corr(
-            b, divergence_deposits(vlab, None, None, fac[:, 0, 0]), corr)
+            b, divergence_deposits(vlab, ulab, chi, fac[:, 0, 0]), corr)
 
         def A(x):
             lab = assemble_labs_ordered(x[:, None], tpois)
@@ -158,7 +265,7 @@ class AMRSim:
         )
 
         # volume-weighted mean removal (main.cpp:7120-7173)
-        wsum = jnp.sum(hsq) * self.cfg.bs ** 2
+        wsum = jnp.sum(hsq) * cfg.bs ** 2
         dp = res.x - jnp.sum(res.x * hsq) / wsum
         p_new = dp + pord - jnp.sum(pord * hsq) / wsum
 
@@ -170,10 +277,21 @@ class AMRSim:
         pfac = -0.5 * dt * h[:, 0, 0, 0]
         dv = apply_flux_corr(
             dv, gradient_deposits(plab[:, 0], pfac), corr)
-        v = v + dv * ih2
+        v = (v + dv * ih2) * maskv
 
-        vel = vel.at[order].set(v)
-        pres = pres.at[order].set(p_new[:, None])
+        vel_out = vel_full.at[order].set(v)
+        pres_out = pres.at[order].set(p_new[:, None])
+        return vel_out, pres_out, res, v
+
+    # ------------------------------------------------------------------
+    # device step: obstacle-free (the oracle path)
+    # ------------------------------------------------------------------
+    def _step_impl(self, vel, pres, dt, order, h, hsq, maskv,
+                   t3, t1v, t1s, tpois, corr, exact_poisson=False):
+        v = self._advect_rk2(vel, order, h, dt, t3, corr, maskv)
+        vel, pres, res, v = self._pressure_project(
+            vel, v, pres, dt, order, h, hsq, t1v, t1s, tpois, corr,
+            exact_poisson, maskv)
         diag = {
             "poisson_iters": res.iters,
             "poisson_residual": res.residual,
@@ -181,12 +299,194 @@ class AMRSim:
         }
         return vel, pres, diag
 
+    # ------------------------------------------------------------------
+    # device step: with obstacles (the reference hot loop 6607-7187)
+    # ------------------------------------------------------------------
+    def _flow_impl(self, vel, pres, obs, prescribed, dt, order, h, hsq,
+                   maskv, xc, yc, t3, t1v, t1s, tpois, corr,
+                   exact_poisson=False):
+        cfg = self.cfg
+        S = len(self.shapes)
+        v = self._advect_rk2(vel, order, h, dt, t3, corr, maskv)
+        v_cf = v.transpose(1, 0, 2, 3)   # component-first [2,N,BS,BS]
+
+        # rigid momentum solve per shape (main.cpp:6643-6704)
+        uvw = []
+        for k in range(S):
+            if self.shapes[k].free:
+                xr = xc - obs.com[k, 0]
+                yr = yc - obs.com[k, 1]
+                sums = penalization_integrals(
+                    v_cf, obs.chi_s[k], obs.udef_s[k], xr, yr,
+                    cfg.lam * dt, hsq)
+                uvw.append(solve_rigid_momentum(*sums))
+            else:
+                uvw.append(prescribed[k])
+        uvw = jnp.stack(uvw)
+
+        # shape-shape collisions (main.cpp:6705-6943)
+        if S > 1:
+            colls = []
+            for i in range(S):
+                acc = jnp.zeros(7, dtype=v.dtype)
+                for j in range(S):
+                    if i == j:
+                        continue
+                    acc = acc + overlap_integrals(
+                        obs.chi_s[i], obs.chi_s[j], obs.sdf_s[i],
+                        obs.udef_s[i], uvw[i], obs.com[i], xc, yc)
+                colls.append(acc)
+            for i in range(S):
+                for j in range(i + 1, S):
+                    new_i, new_j, _hit = collision_response(
+                        colls[i], colls[j], uvw[i], uvw[j],
+                        obs.mass[i], obs.mass[j],
+                        obs.inertia[i], obs.inertia[j],
+                        obs.com[i], obs.com[j],
+                        self.shapes[i].length)
+                    uvw = uvw.at[i].set(new_i).at[j].set(new_j)
+            for k in range(S):
+                if not self.shapes[k].free:
+                    uvw = uvw.at[k].set(prescribed[k])
+
+        # implicit penalization update, winner shape per cell
+        # (main.cpp:6944-6979)
+        win = jnp.argmax(obs.chi_s, axis=0)
+        us = jnp.zeros_like(v_cf)
+        for k in range(S):
+            xr = xc - obs.com[k, 0]
+            yr = yc - obs.com[k, 1]
+            usk = jnp.stack([
+                uvw[k, 0] - uvw[k, 2] * yr + obs.udef_s[k, 0],
+                uvw[k, 1] + uvw[k, 2] * xr + obs.udef_s[k, 1],
+            ])
+            us = jnp.where(win == k, usk, us)
+        alpha = jnp.where(obs.chi > 0.5, 1.0 / (1.0 + cfg.lam * dt), 1.0)
+        v_cf = alpha * v_cf + (1.0 - alpha) * us
+        v = v_cf.transpose(1, 0, 2, 3)
+
+        udef = self._combined_udef(obs)  # [2,N,BS,BS]
+        vel, pres, res, v = self._pressure_project(
+            vel, v, pres, dt, order, h, hsq, t1v, t1s, tpois, corr,
+            exact_poisson, maskv,
+            chi=obs.chi, udef_b=udef.transpose(1, 0, 2, 3))
+        diag = {
+            "poisson_iters": res.iters,
+            "poisson_residual": res.residual,
+            "umax": jnp.max(jnp.abs(v)),
+        }
+        return vel, pres, uvw, diag
+
+    @staticmethod
+    def _combined_udef(obs: ObstacleForestFields) -> jnp.ndarray:
+        """Deformation-velocity field for the pressure RHS and the
+        initial blend (main.cpp:6980-7006; ties sum)."""
+        return jnp.sum(
+            jnp.where((obs.chi_s >= obs.chi)[:, None], obs.udef_s, 0.0),
+            axis=0)
+
+    # ------------------------------------------------------------------
+    # device: rasterization + chi + integrals (ongrid, main.cpp:4208-4630)
+    # ------------------------------------------------------------------
+    def _rasterize_impl(self, inputs, xc, yc, h3, hsq, t1s):
+        cfg = self.cfg
+        bs = cfg.bs
+        dtype = self.forest.dtype
+        N = xc.shape[0]
+        neg = jnp.asarray(-float(cfg.extent), dtype)
+        S = len(self.shapes)
+
+        # per-shape window rasterization, scattered into block layout
+        # (pad rows target the dropped N-th slot)
+        sdf = jnp.full((N, bs, bs), neg, dtype)
+        per = []
+        for k in range(S):
+            inp = inputs[k]
+            pos = inp["pos"]                 # [P], -1 = padding
+            gpos = jnp.maximum(pos, 0)
+            wmask = pos >= 0
+            xw = xc[gpos]
+            yw = yc[gpos]
+            com = inp["com"]
+            poly = inp["poly"] - com
+            d = polygon_sdf(xw - com[0], yw - com[1], poly)
+            ud = midline_udef(
+                xw - com[0], yw - com[1], inp["mid_r"] - com,
+                inp["mid_v"], inp["mid_nor"], inp["mid_vnor"],
+                inp["width"])                # [2,P,BS,BS]
+            spos = jnp.where(wmask, pos, N)
+            wm3 = wmask[:, None, None]
+            sdf_k = jnp.full((N + 1, bs, bs), neg, dtype).at[spos].set(
+                jnp.where(wm3, d, neg))[:N]
+            udef_k = jnp.zeros((2, N + 1, bs, bs), dtype).at[:, spos].set(
+                jnp.where(wm3[None], ud, 0.0))[:, :N]
+            wm_k = jnp.zeros((N + 1,), dtype).at[spos].set(
+                wmask.astype(dtype))[:N]
+            sdf = jnp.maximum(sdf, sdf_k)
+            per.append((sdf_k, udef_k, wm_k, com))
+
+        # chi from the COMBINED sdf lab at each block's own h
+        # (PutChiOnGrid, main.cpp:3911-3969)
+        slab = assemble_labs_ordered(sdf[:, None], t1s)[:, 0]
+        chi = jnp.zeros((N, bs, bs), dtype)
+        chi_s, sdf_s, udef_s = [], [], []
+        coms, masses, inertias = [], [], []
+        for k in range(S):
+            sdf_k, udef_k, wm_k, com = per[k]
+            chi_k = chi_from_sdf(slab, sdf_k, h3)
+
+            # CoM correction (main.cpp:4468-4487); zero-mass guard
+            m0 = jnp.sum(chi_k * hsq)
+            dcx = jnp.sum(chi_k * hsq * (xc - com[0]))
+            dcy = jnp.sum(chi_k * hsq * (yc - com[1]))
+            safe = jnp.where(m0 > 0, m0, 1.0)
+            com_n = com + jnp.where(
+                m0 > 0, jnp.stack([dcx, dcy]) / safe, 0.0)
+
+            # integrals + udef de-meaning (main.cpp:4488-4560),
+            # window-masked like the uniform path
+            xr = xc - com_n[0]
+            yr = yc - com_n[1]
+            _, _, m, j, iu, iv, ia = shape_integrals(
+                chi_k, udef_k, xr, yr, hsq)
+            corr = jnp.stack([iu - ia * yr, iv + ia * xr])
+            ud = wm_k[None, :, None, None] * (udef_k - corr)
+
+            chi = jnp.maximum(chi, chi_k)
+            chi_s.append(chi_k)
+            sdf_s.append(sdf_k)
+            udef_s.append(ud)
+            coms.append(com_n)
+            masses.append(m)
+            inertias.append(j)
+
+        return ObstacleForestFields(
+            chi=chi, sdf=sdf,
+            chi_s=jnp.stack(chi_s), sdf_s=jnp.stack(sdf_s),
+            udef_s=jnp.stack(udef_s),
+            com=jnp.stack(coms), mass=jnp.stack(masses),
+            inertia=jnp.stack(inertias),
+        )
+
+    # ------------------------------------------------------------------
+    # device: tagging kernels
+    # ------------------------------------------------------------------
     def _vorticity_impl(self, vel, order, h, t1v):
         """Per-block Linf of vorticity (the refinement tag,
         main.cpp:4671-4688)."""
         lab = assemble_labs(vel, order, t1v)
         w = vorticity(lab, 1, h[:, 0])             # [N, BS, BS]
         return jnp.max(jnp.abs(w), axis=(-1, -2))  # [N]
+
+    def _chi_tag_impl(self, chi_field, order, t4s, finest):
+        """GradChiOnTmp (main.cpp:4631-4656): any positive chi in the
+        block's padded window forces refinement (offset 4 at the finest
+        level — where it only blocks compression — else 2)."""
+        lab = assemble_labs(chi_field, order, t4s)[:, 0]   # [N, L, L]
+        c = jnp.clip(lab, 0.0, 1.0)
+        has4 = jnp.max(c, axis=(-1, -2)) > 0.0
+        has2 = jnp.max(c[:, 2:-2, 2:-2], axis=(-1, -2)) > 0.0
+        return jnp.where(finest, has4, has2)
 
     def _prolong_impl(self, field, parents, order, t):
         """[R] parent block labs -> [R, 4, dim, BS, BS] children via the
@@ -237,31 +537,181 @@ class AMRSim:
         return jax.vmap(children)(plabs)
 
     # ------------------------------------------------------------------
+    # device: surface force diagnostics (main.cpp:7188-7284)
+    # ------------------------------------------------------------------
+    def _forces_impl(self, vel, pres, obs, uvw, order, t4v, t4s,
+                     hflat, xc, yc):
+        velp = assemble_labs(vel, order, t4v)                  # [N,2,L,L]
+        chip = assemble_labs_ordered(obs.chi[:, None], t4s)[:, 0]
+        sdfp = assemble_labs_ordered(obs.sdf[:, None], t4s)[:, 0]
+        pord = pres[order][:, 0]
+        out = []
+        for k in range(len(self.shapes)):
+            out.append(surface_forces_blocks(
+                velp, pord, chip, sdfp,
+                obs.udef_s[k].transpose(1, 0, 2, 3), obs.sdf_s[k],
+                xc, yc, obs.com[k], uvw[k], self.cfg.nu, hflat, G=4))
+        return out
+
+    def _log_forces(self, obs, uvw):
+        f = self.forest
+        results = self._forces_jit(
+            f.fields["vel"], f.fields["pres"], obs, uvw, self._order_j,
+            self._tables["vec4t"], self._tables["sca4t"],
+            self._hflat, self._xc, self._yc)
+        self._record_forces(results)
+
+    # ------------------------------------------------------------------
+    # host: obstacle bookkeeping
+    # ------------------------------------------------------------------
+    def _shape_inputs(self):
+        """Select blocks intersecting each body's padded bounding box and
+        build the device rasterization inputs (the reference's
+        AreaSegment-AABB block intersection, main.cpp:4208-4269). Window
+        capacities are padded powers of two, so a moving body only
+        recompiles when it grows past the current capacity."""
+        cfg = self.cfg
+        f = self.forest
+        order = self._order
+        bs = cfg.bs
+        h = cfg.h0 / (1 << f.level[order]).astype(np.float64)
+        x0 = f.bi[order] * bs * h
+        y0 = f.bj[order] * bs * h
+        x1 = x0 + bs * h
+        y1 = y0 + bs * h
+        dt_ = f.dtype
+        out = []
+        for k, s in enumerate(self.shapes):
+            r = 0.625 * s.length + 12.0 * cfg.min_h
+            cx, cy = s.com
+            hit = (x1 > cx - r) & (x0 < cx + r) \
+                & (y1 > cy - r) & (y0 < cy + r)
+            idx = np.nonzero(hit)[0].astype(np.int32)
+            if len(idx) > self._wcap[k]:
+                self._wcap[k] = max(
+                    16, 1 << int(np.ceil(np.log2(len(idx) * 1.3))))
+            pos = np.full(self._wcap[k], -1, np.int32)
+            pos[:len(idx)] = idx
+            mid_r, mid_v, mid_nor, mid_vnor = s.midline_comp_frame()
+            out.append({
+                "pos": jnp.asarray(pos),
+                "poly": jnp.asarray(s.surface_polygon(), dtype=dt_),
+                "mid_r": jnp.asarray(mid_r, dtype=dt_),
+                "mid_v": jnp.asarray(mid_v, dtype=dt_),
+                "mid_nor": jnp.asarray(mid_nor, dtype=dt_),
+                "mid_vnor": jnp.asarray(mid_vnor, dtype=dt_),
+                "width": jnp.asarray(s.width, dtype=dt_),
+                "com": jnp.asarray(s.com, dtype=dt_),
+            })
+        return out
+
+    def _rasterize(self) -> ObstacleForestFields:
+        self._refresh()
+        return self._raster_jit(
+            self._shape_inputs(), self._xc, self._yc, self._h3,
+            self._hsq_flat, self._tables["sca1"])
+
+    def _write_chi(self, obs: ObstacleForestFields):
+        f = self.forest
+        f.fields["chi"] = f.fields["chi"].at[self._order_j].set(
+            obs.chi[:, None])
+
+    def initialize(self):
+        """The reference's startup (main.cpp:6542-6575): levelMax rounds
+        of {rasterize; adapt} refine the grid around the bodies, then
+        the initial velocity is the chi-blended deformation velocity."""
+        if not self.shapes:
+            self._initialized = True
+            return
+        cfg = self.cfg
+        for s in self.shapes:
+            s.advect(0.0, cfg.extents)
+            s.midline(0.0)
+        for _ in range(cfg.level_max):
+            obs = self._rasterize()
+            self._write_chi(obs)
+            if not self.adapt():
+                break
+        obs = self._rasterize()
+        self._write_chi(obs)
+        self._sync_shape_scalars(obs)
+        f = self.forest
+        vel = f.fields["vel"]
+        vord = vel[self._order_j]
+        udef = self._combined_udef(obs).transpose(1, 0, 2, 3)
+        chi_b = obs.chi[:, None]
+        f.fields["vel"] = vel.at[self._order_j].set(
+            vord * (1.0 - chi_b) + udef * chi_b)
+        self._initialized = True
+
+    # ------------------------------------------------------------------
     # host driver
     # ------------------------------------------------------------------
     def compute_dt(self) -> float:
         self._refresh()
         # active slots only — freed slots keep stale data until reused
         umax = float(jnp.max(jnp.abs(
-            self.forest.fields["vel"][self._order_j])))
+            self.forest.fields["vel"][self._order_j]) * self._maskv))
         hmin = self.cfg.h_at(int(self.forest.level[self._order].max()))
         dt_diff = 0.25 * hmin * hmin / (self.cfg.nu + 0.25 * hmin * umax)
         return float(min(dt_diff, self.cfg.cfl * hmin / (umax + 1e-8)))
 
     def step_once(self, dt: Optional[float] = None):
         self._refresh()
-        if dt is None:
-            dt = self.compute_dt()
         f = self.forest
+        if not self.shapes:
+            if dt is None:
+                dt = self.compute_dt()
+            exact = self.step_count < 10
+            vel, pres, diag = self._step_jit(
+                f.fields["vel"], f.fields["pres"], jnp.asarray(dt, f.dtype),
+                self._order_j, self._h, self._hsq_flat, self._maskv,
+                self._tables["vec3"], self._tables["vec1"],
+                self._tables["sca1"], self._tables["pois"], self._corr,
+                exact_poisson=exact)
+            f.fields["vel"] = vel
+            f.fields["pres"] = pres
+            self.time += dt
+            self.step_count += 1
+            return diag
+
+        if not getattr(self, "_initialized", False):
+            self.initialize()
+            self._refresh()
+        if dt is None:
+            dt = min(self.compute_dt(), self._kinematic_dt_cap())
+
+        # ongrid host part (main.cpp:3992-4207)
+        cfg = self.cfg
+        for s in self.shapes:
+            s.advect(dt, cfg.extents)
+            s.midline(self.time)
+        obs = self._rasterize()
+        self._write_chi(obs)
+        self._sync_shape_scalars(obs)
+
+        prescribed = jnp.asarray(
+            [[s.u, s.v, s.omega] for s in self.shapes], dtype=f.dtype)
         exact = self.step_count < 10
-        vel, pres, diag = self._step_jit(
-            f.fields["vel"], f.fields["pres"], jnp.asarray(dt, f.dtype),
-            self._order_j, self._h, self._hsq_flat,
+        vel, pres, uvw, diag = self._flow_jit(
+            f.fields["vel"], f.fields["pres"], obs, prescribed,
+            jnp.asarray(dt, f.dtype), self._order_j, self._h,
+            self._hsq_flat, self._maskv, self._xc, self._yc,
             self._tables["vec3"], self._tables["vec1"],
             self._tables["sca1"], self._tables["pois"], self._corr,
             exact_poisson=exact)
         f.fields["vel"] = vel
         f.fields["pres"] = pres
+
+        uvw_np = np.asarray(uvw, dtype=np.float64)
+        for k, s in enumerate(self.shapes):
+            if s.free:
+                s.u, s.v, s.omega = uvw_np[k]
+
+        if self.compute_forces_every and \
+                self.step_count % self.compute_forces_every == 0:
+            self._log_forces(obs, uvw)
+
         self.time += dt
         self.step_count += 1
         return diag
@@ -274,7 +724,15 @@ class AMRSim:
         cfg = self.cfg
         tags = np.asarray(self._vorticity_jit(
             f.fields["vel"], self._order_j, self._h,
-            self._tables["vec1"]))
+            self._tables["vec1"]))[:self._n_real]
+        if self.shapes and "chi" in f.fields:
+            finest = np.zeros(len(self._mask), bool)
+            finest[:self._n_real] = \
+                f.level[self._order] == cfg.level_max - 1
+            has = np.asarray(self._chi_tag_jit(
+                f.fields["chi"], self._order_j,
+                self._tables["sca4t"], jnp.asarray(finest)))[:self._n_real]
+            tags = np.maximum(tags, np.where(has, 2.0 * cfg.rtol, 0.0))
         order = self._order
 
         # 1 = refine, -1 = compress, 0 = leave
@@ -372,6 +830,10 @@ class AMRSim:
         return groups
 
     def _do_refine(self, keys):
+        """Batched: ONE prolongation kernel + ONE scatter per field.
+        A per-block .at[].set loop would issue refine_count x 4 x fields
+        sequential device updates — minutes of dispatch latency at the
+        canonical case's refine sizes."""
         if not keys:
             return
         f = self.forest
@@ -379,48 +841,54 @@ class AMRSim:
         parents = jnp.asarray(
             [ordpos[f.blocks[k]] for k in keys], jnp.int32)
         prolonged = {
-            name: np.asarray(self._prolong_jit(
+            name: self._prolong_jit(
                 field, parents, self._order_j,
-                self._tables["vec1t" if field.shape[1] == 2 else "sca1t"]))
+                self._tables["vec1t" if field.shape[1] == 2 else "sca1t"])
             for name, field in f.fields.items()
-        }
+        }   # [R, 4, dim, BS, BS] each
+        slots = np.empty((len(keys), 4), np.int32)
         for n, (l, i, j) in enumerate(keys):
             f.release(l, i, j)
             for ci, (a, b) in enumerate([(0, 0), (1, 0), (0, 1), (1, 1)]):
-                s = f.allocate(l + 1, 2 * i + a, 2 * j + b)
-                for name in f.fields:
-                    f.fields[name] = f.fields[name].at[s].set(
-                        prolonged[name][n, ci])
+                slots[n, ci] = f.allocate(l + 1, 2 * i + a, 2 * j + b)
+        flat = jnp.asarray(slots.reshape(-1))
+        for name in f.fields:
+            p = prolonged[name]
+            f.fields[name] = f.fields[name].at[flat].set(
+                p.reshape((-1,) + p.shape[2:]))
 
     def _do_compress(self, groups):
+        """Batched 4->1 restriction: one gather + one restriction op +
+        one scatter per field (same dispatch-latency rationale as
+        _do_refine)."""
         if not groups:
             return
         f = self.forest
-        for sibs in groups:
+        bs = self.cfg.bs
+        # sibling slot matrix BEFORE releasing (gather needs them)
+        sib_slots = np.empty((len(groups), 4), np.int32)
+        parent_slots = np.empty(len(groups), np.int32)
+        for g, sibs in enumerate(groups):
             l, i0, j0 = sibs[0]
-            vals = {}
-            for name, field in f.fields.items():
-                quads = []
-                for (a, b) in [(0, 0), (1, 0), (0, 1), (1, 1)]:
-                    s = f.blocks[(l, i0 + a, j0 + b)]
-                    d = field[s]
-                    quads.append(((a, b), d))
-                dim = field.shape[1]
-                bs = self.cfg.bs
-                parent = jnp.zeros((dim, bs, bs), field.dtype)
-                for (a, b), d in quads:
-                    restr = 0.25 * (
-                        d[:, 0::2, 0::2] + d[:, 1::2, 0::2]
-                        + d[:, 0::2, 1::2] + d[:, 1::2, 1::2])
-                    parent = parent.at[
-                        :, b * bs // 2:(b + 1) * bs // 2,
-                        a * bs // 2:(a + 1) * bs // 2].set(restr)
-                vals[name] = parent
+            for ci, (a, b) in enumerate([(0, 0), (1, 0), (0, 1), (1, 1)]):
+                sib_slots[g, ci] = f.blocks[(l, i0 + a, j0 + b)]
+        gathered = {name: field[jnp.asarray(sib_slots)]
+                    for name, field in f.fields.items()}
+        for g, sibs in enumerate(groups):
+            l, i0, j0 = sibs[0]
             for (a, b) in [(0, 0), (1, 0), (0, 1), (1, 1)]:
                 f.release(l, i0 + a, j0 + b)
-            s = f.allocate(l - 1, i0 // 2, j0 // 2)
-            for name in f.fields:
-                f.fields[name] = f.fields[name].at[s].set(vals[name])
+            parent_slots[g] = f.allocate(l - 1, i0 // 2, j0 // 2)
+        pj = jnp.asarray(parent_slots)
+        for name, d in gathered.items():
+            # d: [G, 4, dim, BS, BS], children ordered (0,0),(1,0),(0,1),(1,1)
+            restr = 0.25 * (
+                d[..., 0::2, 0::2] + d[..., 1::2, 0::2]
+                + d[..., 0::2, 1::2] + d[..., 1::2, 1::2])
+            row0 = jnp.concatenate([restr[:, 0], restr[:, 1]], axis=-1)
+            row1 = jnp.concatenate([restr[:, 2], restr[:, 3]], axis=-1)
+            parent = jnp.concatenate([row0, row1], axis=-2)
+            f.fields[name] = f.fields[name].at[pj].set(parent)
 
     def run(self, tend: float, max_steps: int = 10**9):
         diag = {}

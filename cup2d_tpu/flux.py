@@ -222,30 +222,36 @@ def build_poisson_tables(forest: Forest, order: np.ndarray) -> HaloTables:
 # ---------------------------------------------------------------------------
 
 class FluxCorrTables(NamedTuple):
-    """Correction rows: value[dest] += D[cidx] + D[fidx1] + D[fidx2],
-    where D is a [n_active * 4 * BS, dim] face-deposit array. One row per
-    coarse edge cell whose face abuts a finer neighbor (the reference's
-    fillcase0+fillcase1 combination)."""
+    """Correction rows: value[dest] += valid * (D[cidx] + D[fidx1] +
+    D[fidx2]), where D is a [n_active * 4 * BS, dim] face-deposit array.
+    One row per coarse edge cell whose face abuts a finer neighbor (the
+    reference's fillcase0+fillcase1 combination). Rows are padded to
+    power-of-two buckets (``valid`` = 0, dest pointing at a dead pad-row
+    cell) so the jitted step's argument shapes survive regrids — same
+    rationale as halo.pad_tables."""
 
     dest: jnp.ndarray    # [M] into ordered cell layout [n_active*BS*BS]
     cidx: jnp.ndarray    # [M] coarse block's own face deposit
     fidx1: jnp.ndarray   # [M] fine subface deposits (the pair)
     fidx2: jnp.ndarray   # [M]
-    n_active: int
-    bs: int
+    valid: jnp.ndarray   # [M] 1.0 real row / 0.0 padding
 
 
 jax.tree_util.register_pytree_node(
     FluxCorrTables,
-    lambda t: ((t.dest, t.cidx, t.fidx1, t.fidx2), (t.n_active, t.bs)),
-    lambda aux, ch: FluxCorrTables(*ch, *aux),
+    lambda t: ((t.dest, t.cidx, t.fidx1, t.fidx2, t.valid), ()),
+    lambda aux, ch: FluxCorrTables(*ch),
 )
 
 
-def build_flux_corr(forest: Forest, order: np.ndarray) -> FluxCorrTables:
+def build_flux_corr(forest: Forest, order: np.ndarray,
+                    n_pad: int = 0) -> FluxCorrTables:
     """Topology-only; shared by every corrected kernel (the per-kernel
-    physics lives in the deposit arrays)."""
+    physics lives in the deposit arrays). ``n_pad`` > len(order) enables
+    shape-stable row padding (pad rows target the first pad block's
+    cell 0, which the caller's mask discards)."""
     bs = forest.bs
+    n_real = len(order)
     ordpos = {int(s): k for k, s in enumerate(order)}
     dest, cidx, f1, f2 = [], [], [], []
     for k, s in enumerate(order):
@@ -271,10 +277,21 @@ def build_flux_corr(forest: Forest, order: np.ndarray) -> FluxCorrTables:
                 cidx.append((k * 4 + face) * bs + t)
                 f1.append((kf * 4 + opp) * bs + tf0)
                 f2.append((kf * 4 + opp) * bs + tf0 + 1)
+    m_real = len(dest)
+    if n_pad:
+        assert n_pad > n_real
+        m = max(64, 1 << max(0, (m_real - 1)).bit_length())
+        dead = n_real * bs * bs
+        dest += [dead] * (m - m_real)
+        cidx += [0] * (m - m_real)
+        f1 += [0] * (m - m_real)
+        f2 += [0] * (m - m_real)
+    valid = np.zeros(len(dest), np.float32)
+    valid[:m_real] = 1.0
     as_i = lambda a: jnp.asarray(np.asarray(a, np.int32))
     return FluxCorrTables(
         dest=as_i(dest), cidx=as_i(cidx), fidx1=as_i(f1), fidx2=as_i(f2),
-        n_active=len(order), bs=bs,
+        valid=jnp.asarray(valid),
     )
 
 
@@ -283,15 +300,16 @@ def apply_flux_corr(values: jnp.ndarray, deposits: jnp.ndarray,
     """values: [N, BS, BS] or [N, dim, BS, BS] kernel output (ordered);
     deposits: [N, 4, BS] or [N, 4, BS, dim] from a `*_deposits` helper.
     Returns corrected values (the reference's fillcases add)."""
+    valid = t.valid.astype(values.dtype)
     if values.ndim == 3:
         flat = values.reshape(-1)
         d = deposits.reshape(-1)
-        corr = d[t.cidx] + d[t.fidx1] + d[t.fidx2]
+        corr = valid * (d[t.cidx] + d[t.fidx1] + d[t.fidx2])
         return flat.at[t.dest].add(corr).reshape(values.shape)
     n, dim, bs, _ = values.shape
     flat = values.transpose(0, 2, 3, 1).reshape(-1, dim)
     d = deposits.reshape(-1, dim)
-    corr = d[t.cidx] + d[t.fidx1] + d[t.fidx2]
+    corr = valid[:, None] * (d[t.cidx] + d[t.fidx1] + d[t.fidx2])
     out = flat.at[t.dest].add(corr)
     return out.reshape(n, bs, bs, dim).transpose(0, 3, 1, 2)
 

@@ -45,6 +45,10 @@ class Forest:
         self.capacity = capacity or max(
             64, 4 * n_init,
             4 * nb0 << (2 * min(cfg.level_max - 1, 3)))
+        # multiple of 64 so the slot axis divides device meshes up to 64
+        # chips (parallel/forest_mesh.py shards it); _grow doubles, so
+        # divisibility is preserved
+        self.capacity = -(-self.capacity // 64) * 64
         self.blocks: Dict[Tuple[int, int, int], int] = {}
         self.level = np.zeros(self.capacity, np.int32)
         self.bi = np.zeros(self.capacity, np.int32)
@@ -62,9 +66,25 @@ class Forest:
                 self.allocate(lvl, i, j)
 
     # -- slot management ------------------------------------------------
+    def _grow(self):
+        """Double the slot capacity in place: pad the metadata arrays and
+        every field (the reference mallocs per block, main.cpp:2162; a
+        dense SoA pays one realloc + device pad instead)."""
+        old = self.capacity
+        new = old * 2
+        self.level = np.concatenate([self.level, np.zeros(old, np.int32)])
+        self.bi = np.concatenate([self.bi, np.zeros(old, np.int32)])
+        self.bj = np.concatenate([self.bj, np.zeros(old, np.int32)])
+        self.active = np.concatenate([self.active, np.zeros(old, bool)])
+        for name, fld in self.fields.items():
+            pad = jnp.zeros((old,) + fld.shape[1:], fld.dtype)
+            self.fields[name] = jnp.concatenate([fld, pad], axis=0)
+        self._free.extend(range(new - 1, old - 1, -1))
+        self.capacity = new
+
     def allocate(self, l: int, i: int, j: int) -> int:
         if not self._free:
-            raise RuntimeError("forest capacity exhausted")
+            self._grow()
         s = self._free.pop()
         self.blocks[(l, i, j)] = s
         self.level[s] = l
@@ -113,12 +133,19 @@ class Forest:
 
     # -- cell ownership (the reference's treef queries) -----------------
     def owner_relation(self, l: int, i: int, j: int) -> int:
-        """For block (l,i,j): 0 = active here, -1 = refined (children
-        active), -2 = coarser parent active, -3 = nothing (the reference
-        tree codes, main.cpp:672-688)."""
+        """For block (l,i,j): 0 = active here, -1 = region is refined
+        (finer blocks cover it), -2 = coarser parent active, -3 = nothing
+        (the reference tree codes, main.cpp:672-688; its treef keeps the
+        parent entry at -1 through arbitrarily deep refinement). Any of
+        the four children existing means refined — a child may itself be
+        refined deeper, but 2:1 balance guarantees the face-adjacent
+        children a caller needs do exist."""
         if (l, i, j) in self.blocks:
             return 0
-        if (l + 1, 2 * i, 2 * j) in self.blocks:
+        i2, j2 = 2 * i, 2 * j
+        b = self.blocks
+        if (l + 1, i2, j2) in b or (l + 1, i2 + 1, j2) in b \
+                or (l + 1, i2, j2 + 1) in b or (l + 1, i2 + 1, j2 + 1) in b:
             return -1
         if (l - 1, i // 2, j // 2) in self.blocks:
             return -2

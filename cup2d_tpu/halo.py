@@ -365,6 +365,50 @@ def build_tables(forest: Forest, order: np.ndarray, g: int,
     )
 
 
+def _bucket(n: int, lo: int = 64) -> int:
+    return max(lo, 1 << max(0, (n - 1)).bit_length())
+
+
+def pad_tables(t: HaloTables, n_pad: int) -> HaloTables:
+    """Pad a table set so its array shapes are stable across regrids:
+    the block axis to ``n_pad`` (> the real block count), row counts and
+    the interpolation width K to power-of-two buckets. XLA keys compiled
+    executables on argument shapes — without this every regrid would
+    retrace the jitted step (the exact r1 cost block-bucketing was meant
+    to remove, VERDICT weak #6). Pad rows write zeros into the first
+    PAD-row lab cell (index n_real*L*L — valid precisely because
+    n_pad > n_real) and gather field cell 0 with zero weight."""
+    n_real = t.n_active
+    assert n_pad > n_real
+    dead = n_real * t.L * t.L
+
+    def pad1(a, n, fill):
+        return np.pad(np.asarray(a), (0, n - a.shape[0]),
+                      constant_values=fill)
+
+    gs = _bucket(t.dest_s.shape[0])
+    gg = _bucket(t.dest.shape[0])
+    k = max(4, 1 << max(0, (t.idx.shape[1] - 1)).bit_length())
+    sign = np.zeros((gs, t.dim), np.asarray(t.sign).dtype)
+    sign[:t.sign.shape[0]] = t.sign
+    idx = np.zeros((gg, k), np.int32)
+    idx[:t.idx.shape[0], :t.idx.shape[1]] = t.idx
+    idx_ord = np.zeros((gg, k), np.int32)
+    idx_ord[:t.idx.shape[0], :t.idx.shape[1]] = t.idx_ord
+    w = np.zeros((gg, k, t.dim), np.asarray(t.w).dtype)
+    w[:t.w.shape[0], :t.w.shape[1]] = t.w
+    return HaloTables(
+        dest_s=jnp.asarray(pad1(t.dest_s, gs, dead)),
+        src=jnp.asarray(pad1(t.src, gs, 0)),
+        src_ord=jnp.asarray(pad1(t.src_ord, gs, 0)),
+        sign=jnp.asarray(sign),
+        dest=jnp.asarray(pad1(t.dest, gg, dead)),
+        idx=jnp.asarray(idx), idx_ord=jnp.asarray(idx_ord),
+        w=jnp.asarray(w),
+        n_active=n_pad, L=t.L, g=t.g, dim=t.dim,
+    )
+
+
 def assemble_labs(field: jnp.ndarray, order, tables: HaloTables):
     """[cap, dim, BS, BS] field -> [n_active, dim, L, L] ghost-padded labs.
 

@@ -53,31 +53,20 @@ _XDMF_TEMPLATE = """<Xdmf
 """
 
 
-def dump_uniform(path: str, time: float, vel, h: float,
-                 origin=(0.0, 0.0)) -> None:
-    """Write a uniform-grid velocity field in the reference dump format.
-
-    vel: [2, Ny, Nx] (numpy or jax array). Cells are emitted in row-major
-    (y-outer) order, like the reference's per-block x-inner loop.
-    """
-    vel = np.asarray(vel, dtype=np.float64)
-    _, ny, nx = vel.shape
-    ncell = ny * nx
-
-    x0 = origin[0] + np.arange(nx) * h
-    y0 = origin[1] + np.arange(ny) * h
-    xg, yg = np.meshgrid(x0, y0, indexing="xy")   # [ny, nx]
-    x1 = xg + h
-    y1 = yg + h
+def _write_quads(path: str, time: float, xg, yg, x1, y1, u, v) -> None:
+    """Emit the reference dump triplet from per-cell corner/value arrays
+    (any shape; raveled in C order). (x0,y0),(x0,y1),(x1,y1),(x1,y0)
+    corner order, (u, v, 0) attr triplets — main.cpp:3367-3467."""
+    ncell = int(np.prod(np.shape(u)))
     xyz = np.empty((ncell, 4, 2), dtype=np.float32)
-    xyz[:, 0, 0] = xg.ravel(); xyz[:, 0, 1] = yg.ravel()
-    xyz[:, 1, 0] = xg.ravel(); xyz[:, 1, 1] = y1.ravel()
-    xyz[:, 2, 0] = x1.ravel(); xyz[:, 2, 1] = y1.ravel()
-    xyz[:, 3, 0] = x1.ravel(); xyz[:, 3, 1] = yg.ravel()
+    xyz[:, 0, 0] = np.ravel(xg); xyz[:, 0, 1] = np.ravel(yg)
+    xyz[:, 1, 0] = np.ravel(xg); xyz[:, 1, 1] = np.ravel(y1)
+    xyz[:, 2, 0] = np.ravel(x1); xyz[:, 2, 1] = np.ravel(y1)
+    xyz[:, 3, 0] = np.ravel(x1); xyz[:, 3, 1] = np.ravel(yg)
 
     attr = np.zeros((ncell, 3), dtype=np.float32)
-    attr[:, 0] = vel[0].ravel()
-    attr[:, 1] = vel[1].ravel()
+    attr[:, 0] = np.ravel(u)
+    attr[:, 1] = np.ravel(v)
 
     xyz.tofile(path + ".xyz.raw")
     attr.tofile(path + ".attr.raw")
@@ -87,6 +76,47 @@ def dump_uniform(path: str, time: float, vel, h: float,
             xyz_base=os.path.basename(path) + ".xyz.raw",
             attr_base=os.path.basename(path) + ".attr.raw",
         ))
+
+
+def dump_uniform(path: str, time: float, vel, h: float,
+                 origin=(0.0, 0.0)) -> None:
+    """Write a uniform-grid velocity field in the reference dump format.
+
+    vel: [2, Ny, Nx] (numpy or jax array). Cells are emitted in row-major
+    (y-outer) order, like the reference's per-block x-inner loop.
+    """
+    vel = np.asarray(vel, dtype=np.float64)
+    _, ny, nx = vel.shape
+    x0 = origin[0] + np.arange(nx) * h
+    y0 = origin[1] + np.arange(ny) * h
+    xg, yg = np.meshgrid(x0, y0, indexing="xy")   # [ny, nx]
+    _write_quads(path, time, xg, yg, xg + h, yg + h, vel[0], vel[1])
+
+
+def dump_forest(path: str, time: float, forest, order=None) -> None:
+    """Write an adaptive forest's velocity in the reference dump format.
+
+    The format is per-cell quads precisely so resolution can vary
+    (main.cpp:3367-3467 writes one quad per cell of every block); blocks
+    are emitted in SFC order, cells y-outer/x-inner within each block —
+    the reference's own emission order."""
+    order = forest.order() if order is None else order
+    bs = forest.bs
+    n = len(order)
+    vel = np.asarray(forest.fields["vel"][order], dtype=np.float64)
+
+    h = forest.cfg.h0 / (1 << forest.level[order]).astype(np.float64)
+    ar = np.arange(bs, dtype=np.float64)
+    x0b = forest.bi[order].astype(np.float64) * bs * h
+    y0b = forest.bj[order].astype(np.float64) * bs * h
+    shape = (n, bs, bs)
+    xg = np.broadcast_to(
+        x0b[:, None, None] + ar[None, None, :] * h[:, None, None], shape)
+    yg = np.broadcast_to(
+        y0b[:, None, None] + ar[None, :, None] * h[:, None, None], shape)
+    x1 = xg + h[:, None, None]
+    y1 = yg + h[:, None, None]
+    _write_quads(path, time, xg, yg, x1, y1, vel[:, 0], vel[:, 1])
 
 
 def read_dump(path: str):
@@ -116,8 +146,19 @@ def save_checkpoint(dirpath: str, sim) -> None:
         import shutil
         shutil.rmtree(tmp)
     os.makedirs(tmp)
-    fields = {k: np.asarray(v) for k, v in sim.state._asdict().items()}
-    np.savez(os.path.join(tmp, "fields.npz"), **fields)
+    if hasattr(sim, "forest"):
+        # adaptive: topology as (level, i, j) keys + fields in SFC order
+        # (slot numbering is an allocator detail that need not survive)
+        f = sim.forest
+        order = f.order()
+        keys = np.stack([f.level[order], f.bi[order], f.bj[order]],
+                        axis=1).astype(np.int32)
+        fields = {k: np.asarray(v[order]) for k, v in f.fields.items()}
+        np.savez(os.path.join(tmp, "fields.npz"),
+                 __forest_keys=keys, **fields)
+    else:
+        fields = {k: np.asarray(v) for k, v in sim.state._asdict().items()}
+        np.savez(os.path.join(tmp, "fields.npz"), **fields)
     shapes = getattr(sim, "shapes", [])
     with open(os.path.join(tmp, "shapes.pkl"), "wb") as f:
         pickle.dump(shapes, f)
@@ -156,10 +197,24 @@ def load_checkpoint(dirpath: str, sim) -> None:
         if os.path.exists(os.path.join(old, "meta.json")):
             dirpath = old
     with np.load(os.path.join(dirpath, "fields.npz")) as data:
-        sim.state = type(sim.state)(**{
-            k: jnp.asarray(data[k], dtype=sim.grid.dtype)
-            for k in sim.state._fields
-        })
+        if "__forest_keys" in data:
+            f = sim.forest
+            for key in list(f.blocks):
+                f.release(*key)
+            keys = data["__forest_keys"]
+            slots = np.asarray(
+                [f.allocate(int(l), int(i), int(j)) for (l, i, j) in keys],
+                np.int32)
+            for name in f.fields:
+                vals = jnp.asarray(data[name], dtype=f.dtype)
+                f.fields[name] = jnp.zeros(
+                    (f.capacity,) + vals.shape[1:], f.dtype
+                ).at[jnp.asarray(slots)].set(vals)
+        else:
+            sim.state = type(sim.state)(**{
+                k: jnp.asarray(data[k], dtype=sim.grid.dtype)
+                for k in sim.state._fields
+            })
     with open(os.path.join(dirpath, "meta.json")) as f:
         meta = json.load(f)
     sim.time = float(meta["time"])

@@ -38,8 +38,11 @@ def overlap_integrals(chi_i, chi_j, sdf_i, udef_i, uvw_i, com_i, x, y):
     mom_x = jnp.sum(w * (uvw_i[0] + ur_x + udef_i[0]))
     mom_y = jnp.sum(w * (uvw_i[1] + ur_y + udef_i[1]))
     # central SDF gradient (undivided); the reference falls back to
-    # one-sided at block edges only because its blocks lack ghosts
-    lab = jnp.pad(sdf_i, 1, mode="edge")
+    # one-sided at block edges only because its blocks lack ghosts.
+    # Pad the last two axes only, so [N, BS, BS] forest layouts (leading
+    # block axis) work as well as [Ny, Nx] uniform grids.
+    pad = [(0, 0)] * (sdf_i.ndim - 2) + [(1, 1), (1, 1)]
+    lab = jnp.pad(sdf_i, pad, mode="edge")
     gx = 0.5 * (shift(lab, 1, 0, 1) - shift(lab, 1, 0, -1))
     gy = 0.5 * (shift(lab, 1, 1, 0) - shift(lab, 1, -1, 0))
     vec_x = jnp.sum(w * gx)

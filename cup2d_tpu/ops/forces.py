@@ -11,15 +11,23 @@ pressure * n_chi), torque, thrust/drag split along the body velocity,
 lift, and output/deformation power — the reference's 19-component
 per-shape reduction (main.cpp:7188-7284).
 
-Deviations from the reference, both documented improvements over block
-artifacts: derivative stencil order degrades only near the *domain*
-boundary (the reference degrades near every 8-cell block edge because its
-lab ends there), and surface membership for overlapping bodies is
-cell-granular (own-sdf band) instead of block-granular.
+`surface_forces_block` is the single-tile core over ghost-padded labs,
+with the reference's probe/stencil lab-edge gates; the AMR path vmaps it
+over forest blocks with G=4 (the reference's own lab extent, including
+its stencil-order degradation at lab edges — see `surface_forces_blocks`)
+and the uniform wrapper `surface_forces` calls it as one big tile with
+G=10 ghosts, so derivative order degrades only near the *domain*
+boundary — a documented improvement over the reference's per-8-cell-block
+artifacts. Surface membership for overlapping bodies is cell-granular
+(own-sdf band) instead of the reference's block-granular choice — the
+second documented deviation.
 """
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 
 _EPS = 2.220446049250313e-16
@@ -35,24 +43,21 @@ FORCE_KEYS = (
 )
 
 
-def surface_forces(vel, pres, chi, sdf, udef, own_sdf, com, uvw, nu, h):
-    """Per-shape force reduction. All fields are full-grid [.., Ny, Nx];
-    ``chi``/``sdf`` are the combined fields, ``own_sdf``/``udef`` the
-    shape's own. Returns a dict of the 19 reference diagnostics."""
-    ny, nx = chi.shape
-    G = 10  # covers probe walk (<=4) + 5-cell stencils
-    chip = jnp.pad(chi, G, mode="edge")
-    sdfp = jnp.pad(sdf, G, mode="edge")
-    # free-slip mirror for velocity ghosts (VectorLab, main.cpp:3127)
-    velp = jnp.pad(vel, ((0, 0), (G, G), (G, G)), mode="edge")
-    sgnx = jnp.ones(nx + 2 * G, vel.dtype).at[:G].set(-1).at[nx + G:].set(-1)
-    sgny = jnp.ones(ny + 2 * G, vel.dtype).at[:G].set(-1).at[ny + G:].set(-1)
-    velp = jnp.stack([velp[0] * sgnx[None, :], velp[1] * sgny[:, None]])
+def surface_forces_block(velp, pres, chip, sdfp, udef, own_sdf, xc, yc,
+                         com, uvw, nu, h, G):
+    """Force reduction over ONE ghost-padded tile.
 
+    velp: [2, L, L] velocity lab; chip/sdfp: [L, L] combined chi/sdf
+    labs; pres/own_sdf: [ny, nx] interiors; udef: [2, ny, nx] the
+    shape's own deformation velocity; xc/yc: [ny, nx] cell centers;
+    h scalar (this tile's spacing). Returns the 18 partial sums plus
+    PoutNew assembled by the caller after summing.
+    """
+    ny, nx = pres.shape
     iy, ix = jnp.meshgrid(jnp.arange(ny), jnp.arange(nx), indexing="ij")
 
-    def at_s(field_p, yy, xx):
-        return field_p[yy + G, xx + G]
+    def at_s(lab, yy, xx):
+        return lab[yy + G, xx + G]
 
     def at_v(yy, xx):
         return velp[:, yy + G, xx + G]
@@ -74,14 +79,17 @@ def surface_forces(vel, pres, chi, sdf, udef, own_sdf, com, uvw, nu, h):
     dx_u = norm_x / nmag
     dy_u = norm_y / nmag
 
-    # --- probe walk along the normal to fluid (main.cpp:5619-5632) ---
+    # --- probe walk along the normal to fluid (main.cpp:5619-5632):
+    # a step is taken only while its +-1 neighborhood stays inside the
+    # lab (the reference's inrange gate) ---
     px_i = ix
     py_i = iy
     done = jnp.zeros_like(mask)
     for k in range(5):
         cx = ix + jnp.rint(k * dx_u).astype(jnp.int32)
         cy = iy + jnp.rint(k * dy_u).astype(jnp.int32)
-        inb = (cx >= -4) & (cx <= nx + 3) & (cy >= -4) & (cy <= ny + 3)
+        inb = (cx - 1 >= -G) & (cx + 1 <= nx + G - 1) \
+            & (cy - 1 >= -G) & (cy + 1 <= ny + G - 1)
         take = inb & ~done
         px_i = jnp.where(take, cx, px_i)
         py_i = jnp.where(take, cy, py_i)
@@ -92,15 +100,15 @@ def surface_forces(vel, pres, chi, sdf, udef, own_sdf, com, uvw, nu, h):
 
     def deriv_1d(axis):
         """One-sided first derivative at the probe, 5th/2nd/1st order by
-        distance to the domain edge, per velocity component [2, Ny, Nx]."""
+        distance to the lab edge (main.cpp:5640-5696), per component."""
         if axis == 0:
             off = lambda k: at_v(py_i, px_i + k * sx)  # noqa: E731
             pos, s_, n_ = px_i, sx, nx
         else:
             off = lambda k: at_v(py_i + k * sy, px_i)  # noqa: E731
             pos, s_, n_ = py_i, sy, ny
-        in5 = (pos + 5 * s_ >= -4) & (pos + 5 * s_ < n_ + 4)
-        in2 = (pos + 2 * s_ >= -4) & (pos + 2 * s_ < n_ + 4)
+        in5 = (pos + 5 * s_ >= -G) & (pos + 5 * s_ <= n_ + G - 1)
+        in2 = (pos + 2 * s_ >= -G) & (pos + 2 * s_ <= n_ + G - 1)
         d5 = sum(c * off(k) for k, c in enumerate(_C))
         d2 = -1.5 * off(0) + 2.0 * off(1) - 0.5 * off(2)
         d1 = off(1) - off(0)
@@ -129,22 +137,19 @@ def surface_forces(vel, pres, chi, sdf, udef, own_sdf, com, uvw, nu, h):
 
     # --- traction and reductions (main.cpp:5700-5745) ---
     nuoh = nu / h
-    p_c = pres
     fxv = nuoh * (du_dx * norm_x + du_dy * norm_y)
     fyv = nuoh * (dv_dx * norm_x + dv_dy * norm_y)
-    fxp = -p_c * norm_x
-    fyp = -p_c * norm_y
+    fxp = -pres * norm_x
+    fyp = -pres * norm_y
     fxt = fxv + fxp
     fyt = fyv + fyp
 
-    u_here = vel[0]
-    v_here = vel[1]
+    u_here = at_v(iy, ix)[0]
+    v_here = at_v(iy, ix)[1]
     vel_norm = jnp.sqrt(uvw[0] ** 2 + uvw[1] ** 2)
     unit_x = jnp.where(vel_norm > 0, uvw[0] / (vel_norm + _EPS), 0.0)
     unit_y = jnp.where(vel_norm > 0, uvw[1] / (vel_norm + _EPS), 0.0)
 
-    xc = (ix + 0.5) * h
-    yc = (iy + 0.5) * h
     rx = xc - com[0]
     ry = yc - com[1]
 
@@ -156,7 +161,7 @@ def surface_forces(vel, pres, chi, sdf, udef, own_sdf, com, uvw, nu, h):
     def red(q):
         return jnp.sum(jnp.where(mask, q, 0.0))
 
-    out = {
+    return {
         "perimeter": red(nmag - _EPS),
         "circulation": red(norm_x * v_here - norm_y * u_here),
         "forcex": red(fxt),
@@ -176,5 +181,42 @@ def surface_forces(vel, pres, chi, sdf, udef, own_sdf, com, uvw, nu, h):
         "defPower": red(pow_def),
         "defPowerBnd": red(jnp.minimum(0.0, pow_def)),
     }
+
+
+def _finish(sums, uvw):
+    out = dict(sums)
     out["PoutNew"] = out["forcex"] * uvw[0] + out["forcey"] * uvw[1]
     return out
+
+
+def surface_forces_blocks(velp, pres, chip, sdfp, udef, own_sdf, xc, yc,
+                          com, uvw, nu, h, G=4):
+    """AMR path: vmap the core over [N] forest blocks (velp [N, 2, L, L],
+    labs [N, L, L], interiors [N, ...], h [N]) and sum the partials."""
+    core = functools.partial(surface_forces_block, G=G)
+    per_block = jax.vmap(
+        core, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None, None, 0),
+    )(velp, pres, chip, sdfp, udef, own_sdf, xc, yc, com, uvw, nu, h)
+    sums = {k: jnp.sum(v) for k, v in per_block.items()}
+    return _finish(sums, uvw)
+
+
+def surface_forces(vel, pres, chi, sdf, udef, own_sdf, com, uvw, nu, h):
+    """Uniform-grid wrapper: one big tile with G=10 ghosts (edge-pad
+    scalars, free-slip mirror velocity — VectorLab, main.cpp:3127).
+    Fields are full-grid: vel/udef [2, Ny, Nx], rest [Ny, Nx]."""
+    ny, nx = chi.shape
+    G = 10  # covers probe walk (<=4) + 5-cell stencils away from walls
+    chip = jnp.pad(chi, G, mode="edge")
+    sdfp = jnp.pad(sdf, G, mode="edge")
+    velp = jnp.pad(vel, ((0, 0), (G, G), (G, G)), mode="edge")
+    sgnx = jnp.ones(nx + 2 * G, vel.dtype).at[:G].set(-1).at[nx + G:].set(-1)
+    sgny = jnp.ones(ny + 2 * G, vel.dtype).at[:G].set(-1).at[ny + G:].set(-1)
+    velp = jnp.stack([velp[0] * sgnx[None, :], velp[1] * sgny[:, None]])
+
+    iy, ix = jnp.meshgrid(jnp.arange(ny), jnp.arange(nx), indexing="ij")
+    xc = (ix + 0.5) * h
+    yc = (iy + 0.5) * h
+    sums = surface_forces_block(velp, pres, chip, sdfp, udef, own_sdf,
+                                xc, yc, com, uvw, nu, h, G)
+    return _finish(sums, uvw)

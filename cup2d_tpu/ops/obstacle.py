@@ -163,15 +163,18 @@ def penalization_integrals(vel, chi, udef, xrel, yrel, lamdt, hsq):
 def solve_rigid_momentum(pm, pj, px, py, um, vm, am):
     """Solve the 3x3 system [[PM,0,-PY],[0,PM,PX],[-PY,PX,PJ]] (u,v,w) =
     (UM,VM,AM) (main.cpp:6691-6703, GSL LU there). Normalized by PM for
-    f32 conditioning."""
+    f32 conditioning and solved in closed form — the normalized matrix is
+    [[1,0,a],[0,1,b],[a,b,c]], whose Schur complement is the scalar
+    c - a^2 - b^2 (an explicit LU here would lower to XLA's
+    LuDecomposition expander: f32-only on TPU and absurdly heavyweight
+    for a 3x3)."""
     s = 1.0 / (pm + _EPS)
+    a = -py * s
+    b = px * s
     # tiny ridge keeps the omega row regular when the body has no
     # penalized cells (PM = PJ = 0, an under-resolved body)
-    A = jnp.array([
-        [1.0, 0.0, -py * s],
-        [0.0, 1.0, px * s],
-        [-py * s, px * s, pj * s + 1e-30],
-    ])
-    b = jnp.array([um * s, vm * s, am * s])
-    sol = jnp.linalg.solve(A, b)
+    c = pj * s + 1e-30
+    r0, r1, r2 = um * s, vm * s, am * s
+    w = (r2 - a * r0 - b * r1) / (c - a * a - b * b + _EPS)
+    sol = jnp.stack([r0 - a * w, r1 - b * w, w])
     return jnp.where(pm > 0, sol, jnp.zeros_like(sol))

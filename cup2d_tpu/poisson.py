@@ -324,11 +324,16 @@ def bicgstab(
         better = norm < norm_opt0
         x_opt = jnp.where(better, x, x_opt0)
         norm_opt = jnp.where(better, norm, norm_opt0)
-        # stall exit keyed on the (steadily decreasing) L2 norm; norm_r
-        # is this iteration's entry value, one step behind — immaterial
-        # at the 120-iteration horizon
-        improved = norm_r < 0.999 * s.best_l2
-        best_l2 = jnp.minimum(s.best_l2, norm_r)
+        # stall exit keyed on the L2 norm, sampled ONLY at refresh
+        # iterations: r was re-grounded on the TRUE residual this
+        # iteration (one Krylov update ago), so consecutive samples are
+        # like-for-like. Comparing per-iteration recursive norms against
+        # a refresh-corrected history would latch a drifted-low floor
+        # that true residuals can never beat, firing mid-convergence.
+        l2_now = jnp.sqrt(dot(r, r))
+        improved = refresh & (l2_now < 0.999 * s.best_l2)
+        best_l2 = jnp.where(refresh, jnp.minimum(s.best_l2, l2_now),
+                            s.best_l2)
         impr_it = jnp.where(improved, s.it, s.impr_it)
         stalled = (s.it - impr_it) >= stall_iters
         done = (norm <= target) | give_up | stalled

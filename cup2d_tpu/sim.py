@@ -32,7 +32,7 @@ import numpy as np
 from .config import SimConfig
 from .models import DiskShape, FishShape
 from .ops.collision import collision_response, overlap_integrals
-from .ops.forces import FORCE_KEYS, surface_forces
+from .ops.forces import surface_forces
 from .ops.obstacle import (
     chi_from_sdf,
     midline_udef,
@@ -44,6 +44,7 @@ from .ops.obstacle import (
     solve_rigid_momentum,
     window_coords,
 )
+from .shapes_host import ShapeHostMixin
 from .uniform import FlowState, UniformGrid, pad_scalar
 
 
@@ -75,7 +76,7 @@ def make_shapes(cfg: SimConfig) -> list:
     return out
 
 
-class Simulation:
+class Simulation(ShapeHostMixin):
     """Uniform-grid simulation with immersed obstacles."""
 
     def __init__(self, cfg: SimConfig, shapes: Optional[Sequence] = None,
@@ -293,17 +294,7 @@ class Simulation:
         return out
 
     def _log_forces(self, obs, uvw):
-        results = self._forces(self.state, obs, uvw)
-        for k, (s, r) in enumerate(zip(self.shapes, results)):
-            s.forces = {key: float(r[key]) for key in FORCE_KEYS}
-            if self.force_log is not None:
-                row = [f"{self.time:.8g}", str(k)] + [
-                    f"{s.forces[key]:.8g}" for key in FORCE_KEYS]
-                self.force_log.write(",".join(row) + "\n")
-
-    @staticmethod
-    def force_log_header() -> str:
-        return ",".join(["time", "shape"] + list(FORCE_KEYS))
+        self._record_forces(self._forces(self.state, obs, uvw))
 
     # ------------------------------------------------------------------
     # host driver
@@ -330,20 +321,6 @@ class Simulation:
             })
         return out
 
-    def _sync_shape_scalars(self, obs: ObstacleFields):
-        """CoM correction + M/J/d_gm bookkeeping (main.cpp:4480-4541)."""
-        com = np.asarray(obs.com, dtype=np.float64)
-        mass = np.asarray(obs.mass, dtype=np.float64)
-        inertia = np.asarray(obs.inertia, dtype=np.float64)
-        for k, s in enumerate(self.shapes):
-            s.com[:] = com[k]
-            s.M = float(mass[k])
-            s.J = float(inertia[k])
-            dc = s.center - s.com
-            cth, sth = np.cos(s.orientation), np.sin(s.orientation)
-            s.d_gm[0] = dc[0] * cth + dc[1] * sth
-            s.d_gm[1] = -dc[0] * sth + dc[1] * cth
-
     def initialize(self):
         """Initial velocity := chi-blended deformation velocity
         (main.cpp:6546-6575): u = u (1 - chi) + udef chi."""
@@ -368,21 +345,6 @@ class Simulation:
         return jnp.sum(
             jnp.where((obs.chi_s >= obs.chi)[:, None], obs.udef_s, 0.0),
             axis=0)
-
-    def _kinematic_dt_cap(self) -> float:
-        """Deforming bodies need dt well under their gait period: the
-        grid-umax CFL (main.cpp:6579-6595) cannot see the midline's
-        future motion when the flow is still quiescent (the curvature
-        scheduler ramps from zero), and on coarse grids the diffusive dt
-        limit 0.25 h^2/nu can exceed the period itself — advancing the
-        kinematics by O(period) per step is meaningless and blows up the
-        penalization. The reference dodges this only by always running
-        fine grids (h <= 1/1024 keeps the diffusive cap small). 1/20th
-        of the fastest period resolves the gait; obstacle-free and
-        rigid-shape runs are uncapped, exactly like the reference."""
-        periods = [float(s.current_period) for s in self.shapes
-                   if getattr(s, "current_period", 0.0) > 0.0]
-        return 0.05 * min(periods) if periods else float("inf")
 
     def step_once(self, dt: Optional[float] = None):
         g = self.grid
