@@ -1,0 +1,90 @@
+"""Multi-device execution of the adaptive forest.
+
+The reference partitions SFC-ordered blocks into contiguous per-rank
+ranges and hand-plans P2P halo messages + migration transfers
+(`/root/reference/main.cpp:5205-5424` load balance, `909-2142` comm).
+The TPU-native equivalent keeps the same *policy* — the ordered block
+axis is split into contiguous SFC ranges, one per device — with XLA
+GSPMD as the *mechanism*: the forest's dense per-block arrays carry a
+`NamedSharding` over a 1-D mesh, sharding constraints pin the advected
+velocity and the projection input to the block axis (the partitioner
+propagates that placement through the lab assembly and the Krylov loop),
+and GSPMD inserts the gather/scatter collectives for ghost rows that
+cross shard boundaries plus all-reduces for the dt/residual scalars.
+
+Regrid-time "migration" (the reference's MPI_Block transfers) is just
+re-placement: `_refresh` re-device_puts the rebuilt tables and the
+fields after every topology change, so each device again owns an equal
+contiguous range of the new SFC order. Because the ordered axis is
+padded to power-of-two buckets (amr._refresh), the per-device range
+sizes are always equal and the compiled step is reused across regrids.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..amr import AMRSim
+from ..config import SimConfig
+
+
+class ShardedAMRSim(AMRSim):
+    """AMRSim whose block axis is sharded over a device mesh.
+
+    Same numerics and host driver; only placement differs. The mesh axis
+    is named "x" like the uniform path (parallel/mesh.py) — for a
+    spatial solver the data-parallel axis is space itself, here in
+    SFC-block units rather than grid columns.
+    """
+
+    def __init__(self, cfg: SimConfig, mesh: Mesh,
+                 shapes: Optional[Sequence] = None):
+        self.mesh = mesh
+        super().__init__(cfg, shapes=shapes)
+
+    def _shard_blocks(self, x):
+        """Pin an array's leading (ordered-block) axis to the mesh."""
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P("x")))
+
+    def _refresh(self):
+        f = self.forest
+        if self._tables_version == f.version:
+            return
+        super()._refresh()
+        shard = NamedSharding(self.mesh, P("x"))
+        repl = NamedSharding(self.mesh, P())
+        # fields: shard the slot axis (capacity is a power-of-two-ish
+        # multiple of the mesh size); compact per-block arrays: shard
+        # the padded ordered axis (n_pad is a power of two >= 128)
+        for name, fld in f.fields.items():
+            f.fields[name] = jax.device_put(fld, shard)
+        self._h = jax.device_put(self._h, shard)
+        self._h3 = jax.device_put(self._h3, shard)
+        self._hflat = jax.device_put(self._hflat, shard)
+        self._hsq_flat = jax.device_put(self._hsq_flat, shard)
+        self._maskv = jax.device_put(self._maskv, shard)
+        self._xc = jax.device_put(self._xc, shard)
+        self._yc = jax.device_put(self._yc, shard)
+        self._order_j = jax.device_put(self._order_j, shard)
+        # gather tables are index metadata: replicated, like the
+        # reference replicating its synchronizer plans per rank
+        self._tables = {k: jax.device_put(t, repl)
+                        for k, t in self._tables.items()}
+        self._corr = jax.device_put(self._corr, repl)
+
+    # -- sharding constraints inside the jitted stages -----------------
+    def _advect_rk2(self, vel, order, h, dt, t3, corr, maskv):
+        v = super()._advect_rk2(vel, order, h, dt, t3, corr, maskv)
+        return self._shard_blocks(v)
+
+    def _pressure_project(self, vel, v, pres, dt, order, h, hsq,
+                          t1v, t1s, tpois, corr, exact_poisson, maskv,
+                          chi=None, udef_b=None):
+        v = self._shard_blocks(v)
+        return super()._pressure_project(
+            vel, v, pres, dt, order, h, hsq, t1v, t1s, tpois, corr,
+            exact_poisson, maskv, chi=chi, udef_b=udef_b)
