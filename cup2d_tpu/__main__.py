@@ -13,7 +13,8 @@ names (`/root/reference/main.cpp:6306-6341`, `run.sh:1-22`): e.g.
 By default this executes the adaptive (AMR) path, exactly like the
 reference. Extra flags beyond the reference: ``-level N`` (force a
 single-resolution uniform run at level N), ``-dtype``, ``-output DIR``,
-``-checkpointEvery N``, ``-restart DIR``, ``-maxSteps N``.
+``-checkpointEvery N``, ``-restart DIR``, ``-maxSteps N``, ``-profile``
+(per-phase timer report + cells*steps/s at exit).
 """
 
 from __future__ import annotations
@@ -62,6 +63,9 @@ def main(argv=None) -> int:
         sim = AMRSim(cfg)
     if p.has("restart"):
         load_checkpoint(p("restart").asString(), sim)
+    if p.has("profile"):
+        from .profiling import PhaseTimers
+        sim.timers = PhaseTimers()
 
     force_path = os.path.join(outdir, "forces.csv")
     resuming = p.has("restart") and os.path.exists(force_path)
@@ -104,6 +108,10 @@ def main(argv=None) -> int:
             save_checkpoint(os.path.join(outdir, "checkpoint"), sim)
 
     sim.force_log.close()
+    if sim.timers is not None:
+        from .profiling import throughput
+        print(sim.timers.summary(), file=sys.stderr)
+        print(f"cup2d_tpu: {throughput(sim)}", file=sys.stderr)
     print(f"cup2d_tpu: done at t={sim.time:.6f} "
           f"after {sim.step_count} steps", file=sys.stderr)
     return 0

@@ -60,6 +60,7 @@ from .ops.stencil import advect_diffuse_rhs, divergence, laplacian5, \
     pressure_gradient_update, vorticity
 from .poisson import apply_block_precond_blocks, bicgstab, \
     block_precond_matrix
+from .profiling import NULL_TIMERS
 from .shapes_host import ShapeHostMixin
 
 
@@ -113,6 +114,7 @@ class AMRSim(ShapeHostMixin):
         self._wcap = [16] * len(self.shapes)
         self.compute_forces_every = 1   # 0 disables the diagnostics pass
         self.force_log = None           # file-like, CSV rows
+        self.timers = None              # profiling.PhaseTimers, opt-in
         # jitted ONCE; tables/order/h are arguments, so regrids that
         # reproduce previously-seen shapes hit the XLA compile cache
         self._step_jit = jax.jit(
@@ -132,6 +134,11 @@ class AMRSim(ShapeHostMixin):
         f = self.forest
         if self._tables_version == f.version:
             return
+        with (self.timers or NULL_TIMERS).phase("tables"):
+            self._refresh_impl()
+
+    def _refresh_impl(self):
+        f = self.forest
         self._order = f.order()
         n_real = len(self._order)
         # block axis padded to power-of-two buckets so a regrid that
@@ -170,6 +177,10 @@ class AMRSim(ShapeHostMixin):
                 build_tables(f, self._order, 4, True, 1))
             self._tables["vec4t"] = padded(
                 build_tables(f, self._order, 4, True, 2))
+        # one async transfer for every table leaf (pad_tables returns
+        # numpy on purpose; per-leaf jnp.asarray would synchronize per
+        # array — ~14 s/regrid through the TPU tunnel, measured)
+        self._tables = jax.device_put(self._tables)
         self._corr = build_flux_corr(f, self._order, n_pad=n_pad)
         h = f.h_per_block(self._order)
         hp = np.concatenate([h, np.ones(n_pad - n_real)])
@@ -660,17 +671,23 @@ class AMRSim(ShapeHostMixin):
         self._refresh()
         f = self.forest
         if not self.shapes:
+            tm = self.timers or NULL_TIMERS
             if dt is None:
-                dt = self.compute_dt()
+                with tm.phase("dt"):
+                    dt = self.compute_dt()
             exact = self.step_count < 10
-            vel, pres, diag = self._step_jit(
-                f.fields["vel"], f.fields["pres"], jnp.asarray(dt, f.dtype),
-                self._order_j, self._h, self._hsq_flat, self._maskv,
-                self._tables["vec3"], self._tables["vec1"],
-                self._tables["sca1"], self._tables["pois"], self._corr,
-                exact_poisson=exact)
-            f.fields["vel"] = vel
-            f.fields["pres"] = pres
+            with tm.phase("flow"):
+                vel, pres, diag = self._step_jit(
+                    f.fields["vel"], f.fields["pres"],
+                    jnp.asarray(dt, f.dtype),
+                    self._order_j, self._h, self._hsq_flat, self._maskv,
+                    self._tables["vec3"], self._tables["vec1"],
+                    self._tables["sca1"], self._tables["pois"],
+                    self._corr, exact_poisson=exact)
+                f.fields["vel"] = vel
+                f.fields["pres"] = pres
+                if self.timers is not None:
+                    jax.block_until_ready(vel)  # charge flow to "flow"
             self.time += dt
             self.step_count += 1
             return diag
@@ -678,39 +695,44 @@ class AMRSim(ShapeHostMixin):
         if not getattr(self, "_initialized", False):
             self.initialize()
             self._refresh()
+        tm = self.timers or NULL_TIMERS
         if dt is None:
-            dt = min(self.compute_dt(), self._kinematic_dt_cap())
+            with tm.phase("dt"):
+                dt = min(self.compute_dt(), self._kinematic_dt_cap())
 
         # ongrid host part (main.cpp:3992-4207)
         cfg = self.cfg
-        for s in self.shapes:
-            s.advect(dt, cfg.extents)
-            s.midline(self.time)
-        obs = self._rasterize()
-        self._write_chi(obs)
-        self._sync_shape_scalars(obs)
+        with tm.phase("kinematics"):
+            for s in self.shapes:
+                s.advect(dt, cfg.extents)
+                s.midline(self.time)
+        with tm.phase("rasterize"):
+            obs = self._rasterize()
+            self._write_chi(obs)
+            self._sync_shape_scalars(obs)
 
         prescribed = jnp.asarray(
             [[s.u, s.v, s.omega] for s in self.shapes], dtype=f.dtype)
         exact = self.step_count < 10
-        vel, pres, uvw, diag = self._flow_jit(
-            f.fields["vel"], f.fields["pres"], obs, prescribed,
-            jnp.asarray(dt, f.dtype), self._order_j, self._h,
-            self._hsq_flat, self._maskv, self._xc, self._yc,
-            self._tables["vec3"], self._tables["vec1"],
-            self._tables["sca1"], self._tables["pois"], self._corr,
-            exact_poisson=exact)
-        f.fields["vel"] = vel
-        f.fields["pres"] = pres
-
-        uvw_np = np.asarray(uvw, dtype=np.float64)
+        with tm.phase("flow"):
+            vel, pres, uvw, diag = self._flow_jit(
+                f.fields["vel"], f.fields["pres"], obs, prescribed,
+                jnp.asarray(dt, f.dtype), self._order_j, self._h,
+                self._hsq_flat, self._maskv, self._xc, self._yc,
+                self._tables["vec3"], self._tables["vec1"],
+                self._tables["sca1"], self._tables["pois"], self._corr,
+                exact_poisson=exact)
+            f.fields["vel"] = vel
+            f.fields["pres"] = pres
+            uvw_np = np.asarray(uvw, dtype=np.float64)
         for k, s in enumerate(self.shapes):
             if s.free:
                 s.u, s.v, s.omega = uvw_np[k]
 
         if self.compute_forces_every and \
                 self.step_count % self.compute_forces_every == 0:
-            self._log_forces(obs, uvw)
+            with tm.phase("forces"):
+                self._log_forces(obs, uvw)
 
         self.time += dt
         self.step_count += 1
@@ -719,7 +741,14 @@ class AMRSim(ShapeHostMixin):
     # -- regrid --------------------------------------------------------
     def adapt(self):
         """Tag / 2:1-balance / refine / coarsen (main.cpp:4657-5440)."""
+        # refresh BEFORE entering the phase: table time always lands in
+        # the top-level "tables" bucket, never nested under "adapt" (so
+        # profiling.throughput can sum phases without double counting)
         self._refresh()
+        with (self.timers or NULL_TIMERS).phase("adapt"):
+            return self._adapt_impl()
+
+    def _adapt_impl(self):
         f = self.forest
         cfg = self.cfg
         tags = np.asarray(self._vorticity_jit(

@@ -356,11 +356,17 @@ def build_tables(forest: Forest, order: np.ndarray, g: int,
     bs2 = bs * bs
     src_ord = (ordpos_of[src // bs2] * bs2 + src % bs2).astype(np.int32)
     idx_ord = (ordpos_of[idx // bs2] * bs2 + idx % bs2).astype(np.int32)
+    # HOST (numpy) leaves by design: tables are host-built metadata that
+    # pad_tables post-processes and amr._refresh uploads in ONE async
+    # device_put. Returning device arrays here made pad_tables pull
+    # every leaf back across the TPU tunnel (~70 synchronous round
+    # trips, ~8 s per regrid, measured). jit callers accept numpy
+    # leaves directly (implicit transfer at call time).
     return HaloTables(
-        dest_s=jnp.asarray(dest_s), src=jnp.asarray(src),
-        src_ord=jnp.asarray(src_ord), sign=jnp.asarray(sign),
-        dest=jnp.asarray(dest), idx=jnp.asarray(idx),
-        idx_ord=jnp.asarray(idx_ord), w=jnp.asarray(w),
+        dest_s=dest_s, src=src,
+        src_ord=src_ord, sign=sign,
+        dest=dest, idx=idx,
+        idx_ord=idx_ord, w=w,
         n_active=n_act, L=L, g=g, dim=dim,
     )
 
@@ -397,14 +403,17 @@ def pad_tables(t: HaloTables, n_pad: int) -> HaloTables:
     idx_ord[:t.idx.shape[0], :t.idx.shape[1]] = t.idx_ord
     w = np.zeros((gg, k, t.dim), np.asarray(t.w).dtype)
     w[:t.w.shape[0], :t.w.shape[1]] = t.w
+    # numpy leaves on purpose: the caller device_puts the whole table
+    # SET in one async transfer (per-array jnp.asarray costs one
+    # synchronous round trip each — ~70 of them per regrid)
     return HaloTables(
-        dest_s=jnp.asarray(pad1(t.dest_s, gs, dead)),
-        src=jnp.asarray(pad1(t.src, gs, 0)),
-        src_ord=jnp.asarray(pad1(t.src_ord, gs, 0)),
-        sign=jnp.asarray(sign),
-        dest=jnp.asarray(pad1(t.dest, gg, dead)),
-        idx=jnp.asarray(idx), idx_ord=jnp.asarray(idx_ord),
-        w=jnp.asarray(w),
+        dest_s=pad1(t.dest_s, gs, dead),
+        src=pad1(t.src, gs, 0),
+        src_ord=pad1(t.src_ord, gs, 0),
+        sign=sign,
+        dest=pad1(t.dest, gg, dead),
+        idx=idx, idx_ord=idx_ord,
+        w=w,
         n_active=n_pad, L=t.L, g=t.g, dim=t.dim,
     )
 

@@ -99,6 +99,13 @@ def advect_diffuse_rhs(vlab: jnp.ndarray, g: int, h, nu, dt):
     vlab: [..., 2, Ny+2g, Nx+2g] velocity with ghosts, g >= 3.
     Returns [..., 2, Ny, Nx].
     """
+    return advect_diffuse_core(vlab, g, -dt * h, nu * dt)
+
+
+def advect_diffuse_core(vlab: jnp.ndarray, g: int, afac, dfac):
+    """Same, with the scale factors precomputed — shared verbatim by the
+    XLA path above and the Pallas kernel (ops/pallas_kernels.py), so the
+    two can never drift numerically."""
     assert g >= 3
     u = shift(vlab, g, 0, 0)
     wind_u = u[..., 0:1, :, :]  # u component drives x-derivatives
@@ -121,8 +128,6 @@ def advect_diffuse_rhs(vlab: jnp.ndarray, g: int, h, nu, dt):
         + shift(vlab, g, 1, 0) + shift(vlab, g, -1, 0)
         - 4.0 * u
     )
-    afac = -dt * h
-    dfac = nu * dt
     return afac * (wind_u * dx + wind_v * dy) + dfac * lap
 
 

@@ -1,0 +1,105 @@
+"""Profiling and observability (SURVEY.md §5: the reference has NO
+timers, counters, or traces — a stderr step counter only).
+
+Three tools:
+- ``PhaseTimers``: per-phase wall-clock accumulation. Instrumented
+  code must synchronize inside each phase (the sims block on the
+  phase's device outputs whenever timers are enabled) — without that,
+  async dispatch attributes device time to whoever synchronizes next.
+  Enable on a sim with ``sim.timers = PhaseTimers()``; `report()`
+  gives totals, means, and counts per phase.
+- ``throughput(sim)``: the north-star cells*steps/s metric from a sim's
+  counters (works for uniform and forest sims).
+- ``trace(logdir)``: context manager around `jax.profiler` for a full
+  TensorBoard-readable device trace.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+import jax
+
+
+class PhaseTimers:
+    """Accumulates wall time per named phase across steps."""
+
+    def __init__(self):
+        self.acc = defaultdict(float)
+        self.count = defaultdict(int)
+
+    @contextmanager
+    def phase(self, name: str):
+        """Time a host-side block. The caller is responsible for device
+        fencing (pass the phase's outputs through `fence`)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.acc[name] += time.perf_counter() - t0
+            self.count[name] += 1
+
+    def report(self) -> dict:
+        return {
+            name: {
+                "total_s": self.acc[name],
+                "mean_ms": 1e3 * self.acc[name] / max(1, self.count[name]),
+                "count": self.count[name],
+            }
+            for name in sorted(self.acc)
+        }
+
+    def summary(self) -> str:
+        rows = [f"{k:>16s}: {v['total_s']:8.3f}s total "
+                f"{v['mean_ms']:8.2f}ms/call x{v['count']}"
+                for k, v in self.report().items()]
+        return "\n".join(rows)
+
+
+def throughput(sim) -> dict:
+    """cells*steps/s so far, from the sim's own counters. For forest
+    sims the live cell count is used (the adapted count varies; this is
+    the instantaneous grid, matching how the reference would report)."""
+    if hasattr(sim, "forest"):
+        cells = len(sim.forest.blocks) * sim.forest.bs ** 2
+    else:
+        cells = sim.grid.nx * sim.grid.ny
+    wall = getattr(sim, "timers", None)
+    # phases are non-nested by construction (adapt() refreshes tables
+    # BEFORE opening its phase), so the plain sum is the wall total
+    total = sum(wall.acc.values()) if wall else float("nan")
+    return {
+        "cells": cells,
+        "steps": sim.step_count,
+        "sim_time": sim.time,
+        "wall_s": total,
+        "cells_steps_per_sec": (
+            cells * sim.step_count / total if wall and total > 0
+            else float("nan")),
+    }
+
+
+class _NullTimers:
+    """No-op stand-in so instrumented code needs no branches."""
+
+    @contextmanager
+    def phase(self, name):
+        yield
+
+    def fence(self, name, *arrays):
+        return arrays
+
+
+NULL_TIMERS = _NullTimers()
+
+
+@contextmanager
+def trace(logdir: str):
+    """TensorBoard device trace of the enclosed block."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -44,6 +44,7 @@ from .ops.obstacle import (
     solve_rigid_momentum,
     window_coords,
 )
+from .profiling import NULL_TIMERS
 from .shapes_host import ShapeHostMixin
 from .uniform import FlowState, UniformGrid, pad_scalar
 
@@ -105,6 +106,7 @@ class Simulation(ShapeHostMixin):
         self._dt = jax.jit(g.compute_dt)
         self.compute_forces_every = 1   # 0 disables the diagnostics pass
         self.force_log: Optional[object] = None  # file-like, CSV rows
+        self.timers = None              # profiling.PhaseTimers, opt-in
 
     # ------------------------------------------------------------------
     # device: rasterization + chi + integrals (ongrid, main.cpp:4208-4630)
@@ -351,44 +353,55 @@ class Simulation(ShapeHostMixin):
         cfg = self.cfg
         if not self.shapes:
             # obstacle-free: plain uniform step (no rasterization pass)
+            tm = self.timers or NULL_TIMERS
             if dt is None:
-                dt = float(self._dt(self.state.vel))
+                with tm.phase("dt"):
+                    dt = float(self._dt(self.state.vel))
             exact = self.step_count < 10
-            self.state, diag = self._flow_step_empty(
-                self.state, jnp.asarray(dt, g.dtype), exact_poisson=exact)
+            with tm.phase("flow"):
+                self.state, diag = self._flow_step_empty(
+                    self.state, jnp.asarray(dt, g.dtype),
+                    exact_poisson=exact)
+                if self.timers is not None:
+                    jax.block_until_ready(self.state.vel)
             self.time += dt
             self.step_count += 1
             return diag
         if not getattr(self, "_initialized", False):
             self.initialize()
+        tm = self.timers or NULL_TIMERS
         if dt is None:
-            dt = float(self._dt(self.state.vel))
-            dt = min(dt, self._kinematic_dt_cap())
+            with tm.phase("dt"):
+                dt = float(self._dt(self.state.vel))
+                dt = min(dt, self._kinematic_dt_cap())
 
         # ongrid host part (main.cpp:3992-4207)
-        for s in self.shapes:
-            s.advect(dt, cfg.extents)
-            s.midline(self.time)
+        with tm.phase("kinematics"):
+            for s in self.shapes:
+                s.advect(dt, cfg.extents)
+                s.midline(self.time)
 
-        obs = self._rasterize(self._shape_inputs())
-        self._sync_shape_scalars(obs)
+        with tm.phase("rasterize"):
+            obs = self._rasterize(self._shape_inputs())
+            self._sync_shape_scalars(obs)
 
         prescribed = jnp.asarray(
             [[s.u, s.v, s.omega] for s in self.shapes], dtype=g.dtype
         ) if self.shapes else jnp.zeros((0, 3), g.dtype)
         exact = self.step_count < 10
-        self.state, uvw, diag = self._flow_step(
-            self.state, obs, prescribed,
-            jnp.asarray(dt, g.dtype), exact_poisson=exact)
-
-        uvw_np = np.asarray(uvw, dtype=np.float64)
+        with tm.phase("flow"):
+            self.state, uvw, diag = self._flow_step(
+                self.state, obs, prescribed,
+                jnp.asarray(dt, g.dtype), exact_poisson=exact)
+            uvw_np = np.asarray(uvw, dtype=np.float64)
         for k, s in enumerate(self.shapes):
             if s.free:
                 s.u, s.v, s.omega = uvw_np[k]
 
         if self.shapes and self.compute_forces_every and \
                 self.step_count % self.compute_forces_every == 0:
-            self._log_forces(obs, uvw)
+            with tm.phase("forces"):
+                self._log_forces(obs, uvw)
 
         self.time += dt
         self.step_count += 1

@@ -16,6 +16,7 @@ free-slip / Neumann box boundaries (main.cpp:3126-3256).
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -99,11 +100,24 @@ def taylor_green_state(grid) -> "FlowState":
 
 
 class UniformGrid:
-    """Geometry + jitted operators for one uniform resolution."""
+    """Geometry + jitted operators for one uniform resolution.
 
-    def __init__(self, cfg: SimConfig, level: Optional[int] = None):
+    ``use_pallas`` (or env CUP2D_PALLAS=1) swaps the advection RHS for
+    the hand-tiled Pallas kernel — measured at parity-minus on v5e (the
+    op is VPU-divide-bound, see ops/pallas_kernels.py), so XLA is the
+    default."""
+
+    def __init__(self, cfg: SimConfig, level: Optional[int] = None,
+                 use_pallas: Optional[bool] = None):
         self.cfg = cfg
         lvl = cfg.level_start if level is None else level
+        if use_pallas is None:
+            use_pallas = os.environ.get("CUP2D_PALLAS", "") == "1"
+        if use_pallas:
+            from .ops.pallas_kernels import advect_supported
+            use_pallas = advect_supported(
+                cfg.bpdy * cfg.bs << lvl, cfg.bpdx * cfg.bs << lvl)
+        self.use_pallas = bool(use_pallas)
         self.level = lvl
         self.nx = cfg.bpdx * cfg.bs << lvl
         self.ny = cfg.bpdy * cfg.bs << lvl
@@ -175,8 +189,13 @@ class UniformGrid:
         ih2 = 1.0 / (self.h * self.h)
         vold = vel
         for c in (0.5, 1.0):
-            rhs = advect_diffuse_rhs(
-                pad_vector(vel, 3), 3, self.h, self.cfg.nu, dt)
+            lab = pad_vector(vel, 3)
+            if self.use_pallas:
+                from .ops.pallas_kernels import advect_diffuse_rhs_pallas
+                rhs = advect_diffuse_rhs_pallas(
+                    lab, self.h, self.cfg.nu, dt, self.nx)
+            else:
+                rhs = advect_diffuse_rhs(lab, 3, self.h, self.cfg.nu, dt)
             vel = vold + c * rhs * ih2
         return vel
 
