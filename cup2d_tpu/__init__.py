@@ -20,7 +20,32 @@ diagnostics), re-designed TPU-first:
 See SURVEY.md for the reference layer map this framework covers.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from .config import SimConfig, CommandlineParser, LineParser  # noqa: F401
 from .curve import SpaceCurve  # noqa: F401
+
+# heavier modules (jax-importing) are exposed lazily so `import cup2d_tpu`
+# stays cheap for config-only consumers
+_LAZY = {
+    "AMRSim": ("cup2d_tpu.amr", "AMRSim"),
+    "Simulation": ("cup2d_tpu.sim", "Simulation"),
+    "UniformSim": ("cup2d_tpu.uniform", "UniformSim"),
+    "UniformGrid": ("cup2d_tpu.uniform", "UniformGrid"),
+    "Forest": ("cup2d_tpu.forest", "Forest"),
+    "ShardedUniformSim": ("cup2d_tpu.parallel.mesh", "ShardedUniformSim"),
+    "ShardedAMRSim": ("cup2d_tpu.parallel.forest_mesh", "ShardedAMRSim"),
+    "PhaseTimers": ("cup2d_tpu.profiling", "PhaseTimers"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module 'cup2d_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))
