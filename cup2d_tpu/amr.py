@@ -46,6 +46,7 @@ from .flux import apply_flux_corr, build_flux_corr, build_poisson_tables, \
 from .forest import Forest
 from .halo import assemble_labs, assemble_labs_ordered, build_tables, \
     pad_tables
+from . import native
 from .ops.collision import collision_response, overlap_integrals
 from .ops.forces import surface_forces_blocks
 from .ops.obstacle import (
@@ -791,7 +792,28 @@ class AMRSim(ShapeHostMixin):
     def _fix_states(self, state):
         """2:1 balance sweeps, finest level first (main.cpp:4734-4861):
         a block with a refining finer neighbor must refine; compressing
-        next to a finer or refining neighbor must stay."""
+        next to a finer or refining neighbor must stay. Runs the native
+        C kernel when available (cup2d_tpu/native — the reference's
+        equivalent bookkeeping is C++ inside adapt()); the Python body
+        below is the semantically identical fallback, asserted equal by
+        tests/test_native.py."""
+        cfg = self.cfg
+        if not native.available():   # skip dead marshalling on no-cc hosts
+            return self._fix_states_py(state)
+        keys = list(state.keys())
+        n = len(keys)
+        lvl = np.fromiter((k[0] for k in keys), np.int32, n)
+        bi = np.fromiter((k[1] for k in keys), np.int32, n)
+        bj = np.fromiter((k[2] for k in keys), np.int32, n)
+        st = np.fromiter((state[k] for k in keys), np.int8, n)
+        if native.fix_states(lvl, bi, bj, st, cfg.level_max,
+                             cfg.bpdx, cfg.bpdy):
+            for k, v in zip(keys, st.tolist()):
+                state[k] = v
+            return
+        self._fix_states_py(state)
+
+    def _fix_states_py(self, state):
         f = self.forest
         cfg = self.cfg
         for m in range(cfg.level_max - 1, -1, -1):

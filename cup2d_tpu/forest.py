@@ -117,11 +117,16 @@ class Forest:
 
     def order(self) -> np.ndarray:
         """Active slots sorted by the level-aware SFC id (the reference's
-        id2/Encode order, main.cpp:422-446)."""
-        items = [(int(self.curve.encode(l, i, j)), s)
-                 for (l, i, j), s in self.blocks.items()]
-        items.sort()
-        return np.asarray([s for _, s in items], np.int32)
+        id2/Encode order, main.cpp:422-446). One vectorized encode over
+        all blocks — a per-block Python loop costs ~100 ms at 1e4 blocks
+        of per-regrid host time."""
+        if not self.blocks:
+            return np.empty(0, np.int32)
+        slots = np.fromiter(self.blocks.values(), np.int32,
+                            len(self.blocks))
+        ids = self.curve.encode(
+            self.level[slots], self.bi[slots], self.bj[slots])
+        return slots[np.argsort(ids, kind="stable")]
 
     def origin(self, s: int) -> Tuple[float, float]:
         h = self.h_at(int(self.level[s]))

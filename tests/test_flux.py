@@ -115,6 +115,55 @@ def test_poisson_tables_quadratic_exact():
     assert np.abs(got - want)[mask].max() < 1e-10
 
 
+def test_poisson_closure_second_order_across_interfaces():
+    """Mixed-level SOLUTION convergence: solving A p = b on a two-level
+    forest converges to the analytic p at 2nd order (VERDICT r1 'done'
+    criterion). The closure's pointwise truncation at interface cells is
+    O(h) — same as the reference's identical weights — but conservation
+    plus quadratic-exact ghosts give the classic supra-convergent
+    2nd-order solution error on locally refined grids."""
+    p_inv = None
+    errs = []
+    for ls in (1, 2):
+        cfg = SimConfig(bpdx=2, bpdy=2, level_max=ls + 2, level_start=ls,
+                        extent=1.0, dtype="float64")
+        f = Forest(cfg)
+        # refine the same physical quadrant at both resolutions
+        nbx, nby = f.nblocks_at(ls)
+        for i in range(nbx // 2, nbx):
+            for j in range(nby // 2, nby):
+                f.release(ls, i, j)
+                for a in (0, 1):
+                    for b in (0, 1):
+                        f.allocate(ls + 1, 2 * i + a, 2 * j + b)
+        order = f.order()
+        X, Y, H = _cell_coords(cfg, f, order)
+        p_exact = np.cos(np.pi * X) * np.cos(2 * np.pi * Y)  # Neumann-ok
+        lap = -(np.pi ** 2 + 4 * np.pi ** 2) * p_exact
+        b = lap * H * H
+        b -= b.sum() / b.size            # discrete solvability
+        t = build_poisson_tables(f, order)
+
+        def A(x, t=t):
+            lab = assemble_labs_ordered(x[:, None], t)
+            return laplacian5(lab, 1)[:, 0]
+
+        if p_inv is None:
+            p_inv = jnp.asarray(block_precond_matrix(cfg.bs))
+        res = bicgstab(A, jnp.asarray(b),
+                       M=lambda r: apply_block_precond_blocks(r, p_inv),
+                       tol=1e-12, tol_rel=0.0, max_iter=2000,
+                       max_restarts=50)
+        got = np.asarray(res.x)
+        # compare mean-free solutions, hsq-weighted means
+        w = H * H
+        got = got - (got * w).sum() / w.sum()
+        pe = p_exact - (p_exact * w).sum() / w.sum()
+        errs.append(np.abs(got - pe).max())
+    ratio = errs[0] / errs[1]
+    assert ratio > 3.0, (errs, ratio)   # 2nd order => ratio ~ 4
+
+
 def test_poisson_operator_conservative():
     """Interface fluxes cancel exactly: sum_cells A(x) == 0 for any x
     (each interior face's flux enters its two cells with opposite signs;
