@@ -120,13 +120,15 @@ class AMRSim(ShapeHostMixin):
         # reproduce previously-seen shapes hit the XLA compile cache
         self._step_jit = jax.jit(
             self._step_impl, static_argnames=("exact_poisson",))
-        self._flow_jit = jax.jit(
-            self._flow_impl, static_argnames=("exact_poisson",))
+        self._mega_jit = jax.jit(
+            self._megastep_impl,
+            static_argnames=("exact_poisson", "with_forces"))
+        self._next_dt = None
+        self._next_dt_version = -1
         self._raster_jit = jax.jit(self._rasterize_impl)
         self._vorticity_jit = jax.jit(self._vorticity_impl)
         self._chi_tag_jit = jax.jit(self._chi_tag_impl)
         self._prolong_jit = jax.jit(self._prolong_impl)
-        self._forces_jit = jax.jit(self._forces_impl)
 
     # ------------------------------------------------------------------
     # topology-dependent cached state
@@ -389,6 +391,37 @@ class AMRSim(ShapeHostMixin):
         }
         return vel, pres, uvw, diag
 
+    # ------------------------------------------------------------------
+    # device: the fused per-step megacall — rasterize + flow (+ forces)
+    # + next-dt in ONE dispatch, so a step costs one host->device launch
+    # and one batched device->host pull (each round trip is ~100 ms
+    # through the TPU tunnel; the unfused chain paid ~6 of them)
+    # ------------------------------------------------------------------
+    def _megastep_impl(self, vel, pres, chi_field, inputs, prescribed,
+                       dt, hmin, order, h, hsq, maskv, xc, yc,
+                       t3, t1v, t1s, tpois, t4v, t4s, corr,
+                       exact_poisson=False, with_forces=False):
+        cfg = self.cfg
+        obs = self._rasterize_impl(inputs, xc, yc, h[:, 0], hsq, t1s)
+        chi_new = chi_field.at[order].set(obs.chi[:, None])
+        vel, pres, uvw, diag = self._flow_impl(
+            vel, pres, obs, prescribed, dt, order, h, hsq, maskv,
+            xc, yc, t3, t1v, t1s, tpois, corr,
+            exact_poisson=exact_poisson)
+        # next step's dt from THIS step's end-state umax
+        # (main.cpp:6579-6595), so the host never waits on a separate
+        # reduction at step entry
+        umax = diag["umax"]
+        dt_diff = 0.25 * hmin * hmin / (cfg.nu + 0.25 * hmin * umax)
+        dt_next = jnp.minimum(dt_diff, cfg.cfl * hmin / (umax + 1e-8))
+        forces = None
+        if with_forces:
+            forces = self._forces_impl(
+                vel, pres, obs, uvw, order, t4v, t4s,
+                h[:, 0, 0, 0], xc, yc)
+        scalars = (uvw, obs.com, obs.mass, obs.inertia, dt_next, diag)
+        return vel, pres, chi_new, scalars, forces
+
     @staticmethod
     def _combined_udef(obs: ObstacleForestFields) -> jnp.ndarray:
         """Deformation-velocity field for the pressure RHS and the
@@ -565,14 +598,6 @@ class AMRSim(ShapeHostMixin):
                 xc, yc, obs.com[k], uvw[k], self.cfg.nu, hflat, G=4))
         return out
 
-    def _log_forces(self, obs, uvw):
-        f = self.forest
-        results = self._forces_jit(
-            f.fields["vel"], f.fields["pres"], obs, uvw, self._order_j,
-            self._tables["vec4t"], self._tables["sca4t"],
-            self._hflat, self._xc, self._yc)
-        self._record_forces(results)
-
     # ------------------------------------------------------------------
     # host: obstacle bookkeeping
     # ------------------------------------------------------------------
@@ -698,8 +723,14 @@ class AMRSim(ShapeHostMixin):
             self._refresh()
         tm = self.timers or NULL_TIMERS
         if dt is None:
-            with tm.phase("dt"):
-                dt = min(self.compute_dt(), self._kinematic_dt_cap())
+            # prefer the dt the PREVIOUS megastep computed on device —
+            # a fresh compute_dt() is a full host<->device round trip
+            if self._next_dt is not None and \
+                    self._next_dt_version == f.version:
+                dt = min(self._next_dt, self._kinematic_dt_cap())
+            else:
+                with tm.phase("dt"):
+                    dt = min(self.compute_dt(), self._kinematic_dt_cap())
 
         # ongrid host part (main.cpp:3992-4207)
         cfg = self.cfg
@@ -708,32 +739,43 @@ class AMRSim(ShapeHostMixin):
                 s.advect(dt, cfg.extents)
                 s.midline(self.time)
         with tm.phase("rasterize"):
-            obs = self._rasterize()
-            self._write_chi(obs)
-            self._sync_shape_scalars(obs)
+            inputs = self._shape_inputs()
 
         prescribed = jnp.asarray(
             [[s.u, s.v, s.omega] for s in self.shapes], dtype=f.dtype)
         exact = self.step_count < 10
+        with_forces = bool(
+            self.compute_forces_every
+            and self.step_count % self.compute_forces_every == 0)
+        hmin = jnp.asarray(
+            cfg.h_at(int(f.level[self._order].max())), f.dtype)
         with tm.phase("flow"):
-            vel, pres, uvw, diag = self._flow_jit(
-                f.fields["vel"], f.fields["pres"], obs, prescribed,
-                jnp.asarray(dt, f.dtype), self._order_j, self._h,
-                self._hsq_flat, self._maskv, self._xc, self._yc,
+            vel, pres, chi_new, scalars, forces = self._mega_jit(
+                f.fields["vel"], f.fields["pres"], f.fields["chi"],
+                inputs, prescribed, jnp.asarray(dt, f.dtype), hmin,
+                self._order_j, self._h, self._hsq_flat, self._maskv,
+                self._xc, self._yc,
                 self._tables["vec3"], self._tables["vec1"],
-                self._tables["sca1"], self._tables["pois"], self._corr,
-                exact_poisson=exact)
+                self._tables["sca1"], self._tables["pois"],
+                self._tables.get("vec4t"), self._tables.get("sca4t"),
+                self._corr, exact_poisson=exact,
+                with_forces=with_forces)
             f.fields["vel"] = vel
             f.fields["pres"] = pres
-            uvw_np = np.asarray(uvw, dtype=np.float64)
+            f.fields["chi"] = chi_new
+            # the ONE host pull of the step
+            uvw, com, mass, inertia, dt_next, diag, forces = \
+                jax.device_get((*scalars, forces))
+        self._sync_shape_scalars_np(com, mass, inertia)
+        uvw_np = np.asarray(uvw, dtype=np.float64)
         for k, s in enumerate(self.shapes):
             if s.free:
                 s.u, s.v, s.omega = uvw_np[k]
-
-        if self.compute_forces_every and \
-                self.step_count % self.compute_forces_every == 0:
+        self._next_dt = float(dt_next)
+        self._next_dt_version = f.version
+        if with_forces:
             with tm.phase("forces"):
-                self._log_forces(obs, uvw)
+                self._record_forces(forces)
 
         self.time += dt
         self.step_count += 1

@@ -19,8 +19,11 @@ class ShapeHostMixin:
         """CoM correction + M/J/d_gm bookkeeping (main.cpp:4480-4541).
         One batched device_get — separate np.asarray pulls each pay the
         full device->host latency (~100 ms through the TPU tunnel)."""
-        com, mass, inertia = jax.device_get(
-            (obs.com, obs.mass, obs.inertia))
+        self._sync_shape_scalars_np(*jax.device_get(
+            (obs.com, obs.mass, obs.inertia)))
+
+    def _sync_shape_scalars_np(self, com, mass, inertia):
+        """Same, from already-fetched host arrays (fused-step path)."""
         com = np.asarray(com, dtype=np.float64)
         mass = np.asarray(mass, dtype=np.float64)
         inertia = np.asarray(inertia, dtype=np.float64)
