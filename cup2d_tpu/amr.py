@@ -127,7 +127,7 @@ class AMRSim(ShapeHostMixin):
         self._next_dt_version = -1
         self._raster_jit = jax.jit(self._rasterize_impl)
         self._vorticity_jit = jax.jit(self._vorticity_impl)
-        self._chi_tag_jit = jax.jit(self._chi_tag_impl)
+        self._tags_jit = jax.jit(self._tags_impl)
         self._prolong_jit = jax.jit(self._prolong_impl)
 
     # ------------------------------------------------------------------
@@ -408,12 +408,9 @@ class AMRSim(ShapeHostMixin):
             vel, pres, obs, prescribed, dt, order, h, hsq, maskv,
             xc, yc, t3, t1v, t1s, tpois, corr,
             exact_poisson=exact_poisson)
-        # next step's dt from THIS step's end-state umax
-        # (main.cpp:6579-6595), so the host never waits on a separate
-        # reduction at step entry
-        umax = diag["umax"]
-        dt_diff = 0.25 * hmin * hmin / (cfg.nu + 0.25 * hmin * umax)
-        dt_next = jnp.minimum(dt_diff, cfg.cfl * hmin / (umax + 1e-8))
+        # next step's dt from THIS step's end-state umax, same shared
+        # arithmetic as compute_dt so restarts can't fork the trajectory
+        dt_next = self._dt_from_umax(diag["umax"], hmin)
         forces = None
         if with_forces:
             forces = self._forces_impl(
@@ -532,6 +529,15 @@ class AMRSim(ShapeHostMixin):
         has4 = jnp.max(c, axis=(-1, -2)) > 0.0
         has2 = jnp.max(c[:, 2:-2, 2:-2], axis=(-1, -2)) > 0.0
         return jnp.where(finest, has4, has2)
+
+    def _tags_impl(self, vel, chi_field, order, h, t1v, t4s, finest):
+        """Fused refinement tags: max of the vorticity Linf and the
+        GradChiOnTmp marker (2*Rtol where chi is present) — the two
+        computeA passes the reference runs back to back (adapt(),
+        main.cpp:4659-4661), one dispatch here."""
+        w = self._vorticity_impl(vel, order, h, t1v)
+        has = self._chi_tag_impl(chi_field, order, t4s, finest)
+        return jnp.maximum(w, jnp.where(has, 2.0 * self.cfg.rtol, 0.0))
 
     def _prolong_impl(self, field, parents, order, t):
         """[R] parent block labs -> [R, 4, dim, BS, BS] children via the
@@ -684,14 +690,25 @@ class AMRSim(ShapeHostMixin):
     # ------------------------------------------------------------------
     # host driver
     # ------------------------------------------------------------------
+    def _dt_from_umax(self, umax, hmin):
+        """CFL/diffusive dt (main.cpp:6579-6595). jnp arithmetic shared
+        verbatim by the device path (_megastep_impl's cached next-dt)
+        and the host fallback (compute_dt), in the forest dtype — the
+        two must agree bit-for-bit or a restart forks the trajectory
+        the checkpoint machinery promises to preserve."""
+        cfg = self.cfg
+        dt_diff = 0.25 * hmin * hmin / (cfg.nu + 0.25 * hmin * umax)
+        return jnp.minimum(dt_diff, cfg.cfl * hmin / (umax + 1e-8))
+
     def compute_dt(self) -> float:
         self._refresh()
+        f = self.forest
         # active slots only — freed slots keep stale data until reused
-        umax = float(jnp.max(jnp.abs(
-            self.forest.fields["vel"][self._order_j]) * self._maskv))
-        hmin = self.cfg.h_at(int(self.forest.level[self._order].max()))
-        dt_diff = 0.25 * hmin * hmin / (self.cfg.nu + 0.25 * hmin * umax)
-        return float(min(dt_diff, self.cfg.cfl * hmin / (umax + 1e-8)))
+        umax = jnp.max(jnp.abs(
+            f.fields["vel"][self._order_j]) * self._maskv)
+        hmin = jnp.asarray(
+            self.cfg.h_at(int(f.level[self._order].max())), f.dtype)
+        return float(self._dt_from_umax(umax, hmin))
 
     def step_once(self, dt: Optional[float] = None):
         self._refresh()
@@ -794,17 +811,20 @@ class AMRSim(ShapeHostMixin):
     def _adapt_impl(self):
         f = self.forest
         cfg = self.cfg
-        tags = np.asarray(self._vorticity_jit(
-            f.fields["vel"], self._order_j, self._h,
-            self._tables["vec1"]))[:self._n_real]
+        # one fused dispatch + one pull for both tag kernels (each extra
+        # sync costs a full tunnel round trip)
         if self.shapes and "chi" in f.fields:
             finest = np.zeros(len(self._mask), bool)
             finest[:self._n_real] = \
                 f.level[self._order] == cfg.level_max - 1
-            has = np.asarray(self._chi_tag_jit(
-                f.fields["chi"], self._order_j,
-                self._tables["sca4t"], jnp.asarray(finest)))[:self._n_real]
-            tags = np.maximum(tags, np.where(has, 2.0 * cfg.rtol, 0.0))
+            tags = np.asarray(self._tags_jit(
+                f.fields["vel"], f.fields["chi"], self._order_j,
+                self._h, self._tables["vec1"], self._tables["sca4t"],
+                jnp.asarray(finest)))[:self._n_real]
+        else:
+            tags = np.asarray(self._vorticity_jit(
+                f.fields["vel"], self._order_j, self._h,
+                self._tables["vec1"]))[:self._n_real]
         order = self._order
 
         # 1 = refine, -1 = compress, 0 = leave

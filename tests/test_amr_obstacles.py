@@ -132,6 +132,13 @@ def test_amr_checkpoint_roundtrip(tmp_path):
     b = np.asarray(sim2.forest.fields["vel"][sim2.forest.order()])
     assert np.abs(a - b).max() < 1e-12
 
+    # and WITHOUT an explicit dt: the restarted run's dt fallback must
+    # reproduce the uninterrupted run's device-cached dt (shared
+    # _dt_from_umax arithmetic), so times stay in lockstep
+    sim.step_once()
+    sim2.step_once()
+    assert sim.time == sim2.time, (sim.time, sim2.time)
+
 
 def test_cli_amr_smoke(tmp_path):
     """`python -m cup2d_tpu` with run.sh-style flags (no -level) runs the
