@@ -57,8 +57,8 @@ from .ops.obstacle import (
     shape_integrals,
     solve_rigid_momentum,
 )
-from .ops.stencil import advect_diffuse_rhs, divergence, laplacian5, \
-    pressure_gradient_update, vorticity
+from .ops.stencil import advect_diffuse_rhs, divergence, dt_from_umax, \
+    laplacian5, pressure_gradient_update, vorticity
 from .poisson import apply_block_precond_blocks, bicgstab, \
     block_precond_matrix
 from .profiling import NULL_TIMERS
@@ -691,14 +691,11 @@ class AMRSim(ShapeHostMixin):
     # host driver
     # ------------------------------------------------------------------
     def _dt_from_umax(self, umax, hmin):
-        """CFL/diffusive dt (main.cpp:6579-6595). jnp arithmetic shared
-        verbatim by the device path (_megastep_impl's cached next-dt)
-        and the host fallback (compute_dt), in the forest dtype — the
-        two must agree bit-for-bit or a restart forks the trajectory
-        the checkpoint machinery promises to preserve."""
-        cfg = self.cfg
-        dt_diff = 0.25 * hmin * hmin / (cfg.nu + 0.25 * hmin * umax)
-        return jnp.minimum(dt_diff, cfg.cfl * hmin / (umax + 1e-8))
+        """ops.stencil.dt_from_umax in the forest dtype — the device
+        path (_megastep_impl's cached next-dt) and the host fallback
+        (compute_dt) must agree bit-for-bit or a restart forks the
+        trajectory the checkpoint machinery promises to preserve."""
+        return dt_from_umax(umax, hmin, self.cfg.nu, self.cfg.cfl)
 
     def compute_dt(self) -> float:
         self._refresh()

@@ -21,6 +21,17 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def dt_from_umax(umax, h, nu, cfl):
+    """CFL/diffusive timestep (main.cpp:6579-6595):
+    min(0.25 h^2/(nu + 0.25 h umax), cfl h/(umax + 1e-8)). The ONE
+    definition shared by the uniform and forest paths, on device and
+    from host-pulled umax — cached next-dt and fallback recomputation
+    must agree bit-for-bit or a checkpoint restart forks the
+    trajectory."""
+    dt_diff = 0.25 * h * h / (nu + 0.25 * h * umax)
+    return jnp.minimum(dt_diff, cfl * h / (umax + 1e-8))
+
+
 def interior(lab: jnp.ndarray, g: int) -> jnp.ndarray:
     """Strip g ghost layers from the last two axes."""
     if g == 0:

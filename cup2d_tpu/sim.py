@@ -107,6 +107,7 @@ class Simulation(ShapeHostMixin):
         self.compute_forces_every = 1   # 0 disables the diagnostics pass
         self.force_log: Optional[object] = None  # file-like, CSV rows
         self.timers = None              # profiling.PhaseTimers, opt-in
+        self._next_dt: Optional[float] = None  # from last step's umax
 
     # ------------------------------------------------------------------
     # device: rasterization + chi + integrals (ongrid, main.cpp:4208-4630)
@@ -337,6 +338,7 @@ class Simulation(ShapeHostMixin):
         udef = self._combined_udef(obs)
         vel = self.state.vel * (1.0 - obs.chi) + udef * obs.chi
         self.state = self.state._replace(vel=vel, chi=obs.chi)
+        self._next_dt = None   # the blend rewrote vel; cached dt stale
         self._initialized = True
 
     @staticmethod
@@ -355,15 +357,18 @@ class Simulation(ShapeHostMixin):
             # obstacle-free: plain uniform step (no rasterization pass)
             tm = self.timers or NULL_TIMERS
             if dt is None:
-                with tm.phase("dt"):
-                    dt = float(self._dt(self.state.vel))
+                if self._next_dt is not None:
+                    dt = self._next_dt
+                else:
+                    with tm.phase("dt"):
+                        dt = float(self._dt(self.state.vel))
             exact = self.step_count < 10
             with tm.phase("flow"):
                 self.state, diag = self._flow_step_empty(
                     self.state, jnp.asarray(dt, g.dtype),
                     exact_poisson=exact)
-                if self.timers is not None:
-                    jax.block_until_ready(self.state.vel)
+                # dt_next computed on device inside the step; one pull
+                self._next_dt = float(diag["dt_next"])
             self.time += dt
             self.step_count += 1
             return diag
@@ -371,9 +376,12 @@ class Simulation(ShapeHostMixin):
             self.initialize()
         tm = self.timers or NULL_TIMERS
         if dt is None:
-            with tm.phase("dt"):
-                dt = float(self._dt(self.state.vel))
-                dt = min(dt, self._kinematic_dt_cap())
+            if self._next_dt is not None:
+                dt = min(self._next_dt, self._kinematic_dt_cap())
+            else:
+                with tm.phase("dt"):
+                    dt = min(float(self._dt(self.state.vel)),
+                             self._kinematic_dt_cap())
 
         # ongrid host part (main.cpp:3992-4207)
         with tm.phase("kinematics"):
@@ -393,7 +401,9 @@ class Simulation(ShapeHostMixin):
             self.state, uvw, diag = self._flow_step(
                 self.state, obs, prescribed,
                 jnp.asarray(dt, g.dtype), exact_poisson=exact)
-            uvw_np = np.asarray(uvw, dtype=np.float64)
+            uvw_np, dt_next = jax.device_get((uvw, diag["dt_next"]))
+            uvw_np = np.asarray(uvw_np, dtype=np.float64)
+            self._next_dt = float(dt_next)
         for k, s in enumerate(self.shapes):
             if s.free:
                 s.u, s.v, s.omega = uvw_np[k]

@@ -27,6 +27,7 @@ from .config import SimConfig
 from .ops.stencil import (
     advect_diffuse_rhs,
     divergence_rhs,
+    dt_from_umax,
     laplacian5,
     pressure_gradient_update,
     vorticity,
@@ -150,11 +151,13 @@ class UniformGrid:
         return FlowState(vel=zv, pres=z, chi=z, us=zv, udef=zv)
 
     # -- dt control (main.cpp:6579-6595) --
+    def dt_from_umax(self, umax) -> jnp.ndarray:
+        return dt_from_umax(
+            jnp.asarray(umax, self.dtype),
+            jnp.asarray(self.h, self.dtype), self.cfg.nu, self.cfg.cfl)
+
     def compute_dt(self, vel: jnp.ndarray) -> jnp.ndarray:
-        umax = jnp.max(jnp.abs(vel))
-        dt_diff = 0.25 * self.h * self.h / (self.cfg.nu + 0.25 * self.h * umax)
-        dt_adv = self.h / (umax + 1e-8)
-        return jnp.minimum(dt_diff, self.cfg.cfl * dt_adv)
+        return self.dt_from_umax(jnp.max(jnp.abs(vel)))
 
     # -- Poisson operator: undivided 5-point Laplacian w/ Neumann walls --
     def laplacian(self, p: jnp.ndarray) -> jnp.ndarray:
@@ -215,12 +218,15 @@ class UniformGrid:
         dv = pressure_gradient_update(pad_scalar(pres, 1), 1, h, dt)
         return vel + dv * ih2, pres, res
 
-    @staticmethod
-    def step_diag(vel, res) -> dict:
+    def step_diag(self, vel, res) -> dict:
+        umax = jnp.max(jnp.abs(vel))
         return {
             "poisson_iters": res.iters,
             "poisson_residual": res.residual,
-            "umax": jnp.max(jnp.abs(vel)),
+            "umax": umax,
+            # next step's dt rides the same device call (no separate
+            # dt round trip, r1 weak #10)
+            "dt_next": self.dt_from_umax(umax),
         }
 
     # -- one full projection step (the reference hot loop 6576-7290) --

@@ -132,12 +132,19 @@ def test_amr_checkpoint_roundtrip(tmp_path):
     b = np.asarray(sim2.forest.fields["vel"][sim2.forest.order()])
     assert np.abs(a - b).max() < 1e-12
 
-    # and WITHOUT an explicit dt: the restarted run's dt fallback must
-    # reproduce the uninterrupted run's device-cached dt (shared
-    # _dt_from_umax arithmetic), so times stay in lockstep
-    sim.step_once()
-    sim2.step_once()
-    assert sim.time == sim2.time, (sim.time, sim2.time)
+    # and WITHOUT an explicit dt: a FRESH restart (no cached next-dt)
+    # takes the compute_dt fallback while the uninterrupted run uses
+    # the device-cached value — the shared dt_from_umax arithmetic must
+    # keep times in lockstep
+    path2 = str(tmp_path / "ckpt2")
+    save_checkpoint(path2, sim)
+    sim3 = AMRSim(cfg, shapes=[DiskShape(0.08, 0.55, 0.25)])
+    sim3.compute_forces_every = 0
+    load_checkpoint(path2, sim3)
+    assert sim3._next_dt is None       # the fallback really runs
+    sim.step_once()                    # cached-dt path
+    sim3.step_once()                   # compute_dt fallback path
+    assert sim.time == sim3.time, (sim.time, sim3.time)
 
 
 def test_cli_amr_smoke(tmp_path):
