@@ -170,6 +170,23 @@ def test_cli_amr_smoke(tmp_path):
     assert main(argv2) == 0
 
 
+def test_cli_uniform_smoke(tmp_path):
+    """`-level N` forces the single-resolution uniform path through the
+    same CLI (dump + forces + exit 0)."""
+    from cup2d_tpu.__main__ import main
+    out = str(tmp_path / "uout")
+    argv = ("-bpdx 2 -bpdy 1 -levelMax 3 -levelStart 1 -Rtol 2 -Ctol 1 "
+            "-extent 1 -CFL 0.5 -tend 10 -lambda 1e6 -nu 0.00004 "
+            "-poissonTol 1e-3 -poissonTolRel 0.01 -maxPoissonRestarts 0 "
+            "-maxPoissonIterations 100 -AdaptSteps 5 -tdump 1e-9 "
+            "-maxSteps 2 -level 2").split()
+    argv += ["-shapes", "angle=0 L=0.16 xpos=0.5 ypos=0.25 kind=disk "
+                        "radius=0.08", "-output", out]
+    assert main(argv) == 0
+    assert os.path.exists(os.path.join(out, "forces.csv"))
+    assert [p for p in os.listdir(out) if p.endswith(".xdmf2")]
+
+
 def test_dump_forest_mixed_level(tmp_path):
     """Mixed-level dump: one quad per cell, quad areas sum to the domain
     area, and attrs round-trip the velocity."""
