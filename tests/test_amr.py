@@ -232,7 +232,7 @@ def test_prolong_restrict_linear_roundtrip():
     before = np.asarray(f.fields["vel"][f.blocks[(2, 1, 1)]]).copy()
 
     sim._refresh()
-    sim._do_refine([(2, 1, 1)])  # interior block of the 4x4 grid
+    sim._apply_regrid([(2, 1, 1)], [])  # interior block of the 4x4 grid
     s00 = f.blocks[(3, 2, 2)]
     h3 = cfg.h_at(3)
     x = (2 * bs + np.arange(bs) + 0.5) * h3
@@ -243,6 +243,100 @@ def test_prolong_restrict_linear_roundtrip():
     # compress back: parent restored exactly (mean of exact linears)
     sim._tables_version = -1
     sim._refresh()
-    sim._do_compress([[(3, 2, 2), (3, 3, 2), (3, 2, 3), (3, 3, 3)]])
+    sim._apply_regrid([], [[(3, 2, 2), (3, 3, 2), (3, 2, 3), (3, 3, 3)]])
     s = f.blocks[(2, 1, 1)]
     assert np.allclose(np.asarray(f.fields["vel"][s]), before, atol=1e-12)
+
+
+def test_combined_refine_and_compress_one_dispatch():
+    """Refine and compress in the SAME _apply_regrid call (the
+    production adapt() shape): the restriction must read pre-regrid
+    sibling data even though compress parent slots can reuse slots the
+    same dispatch's refine scatters wrote. Linear field => both the
+    prolonged children and the restored parent are exact."""
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=4, level_start=2,
+                    extent=1.0, dtype="float64", rtol=1e9, ctol=-1.0)
+    sim = AMRSim(cfg)
+    f = sim.forest
+    bs = cfg.bs
+    # refine (2,1,1) up, and pre-build a sibling quad at level 3 over
+    # (2,2,2) to compress down, in one call
+    f.release(2, 2, 2)
+    for a in (0, 1):
+        for b in (0, 1):
+            f.allocate(3, 4 + a, 4 + b)
+    vals = np.zeros((f.capacity, 2, bs, bs))
+    for (l, i, j), s in f.blocks.items():
+        h = cfg.h_at(l)
+        x = (i * bs + np.arange(bs) + 0.5) * h
+        y = (j * bs + np.arange(bs) + 0.5) * h
+        X, Y = np.meshgrid(x, y, indexing="xy")
+        vals[s, 0] = 3.0 * X - 2.0 * Y
+        vals[s, 1] = X + Y
+    f.fields["vel"] = jnp.asarray(vals)
+
+    sim._tables_version = -1
+    sim._refresh()
+    sim._apply_regrid(
+        [(2, 1, 1)],
+        [[(3, 4, 4), (3, 5, 4), (3, 4, 5), (3, 5, 5)]])
+
+    # prolonged child of the refined block: exact linear
+    s00 = f.blocks[(3, 2, 2)]
+    h3 = cfg.h_at(3)
+    x = (2 * bs + np.arange(bs) + 0.5) * h3
+    X, Y = np.meshgrid(x, x, indexing="xy")
+    assert np.allclose(np.asarray(f.fields["vel"][s00, 0]),
+                       3.0 * X - 2.0 * Y, atol=1e-12)
+    # restored parent of the compressed quad: exact linear
+    sp = f.blocks[(2, 2, 2)]
+    h2 = cfg.h_at(2)
+    x = (2 * bs + np.arange(bs) + 0.5) * h2
+    X, Y = np.meshgrid(x, x, indexing="xy")
+    assert np.allclose(np.asarray(f.fields["vel"][sp, 0]),
+                       3.0 * X - 2.0 * Y, atol=1e-12)
+    assert np.allclose(np.asarray(f.fields["vel"][sp, 1]),
+                       X + Y, atol=1e-12)
+
+
+def test_sticky_pad_decay_and_floor():
+    """The padded block axis is a high-water mark with hysteresis: it
+    holds through transient shrinkage, steps down one power of two only
+    after 10 consecutive quarter-full rebuilds, and never decays below
+    the reserve_blocks floor."""
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=3, level_start=1,
+                    extent=1.0, dtype="float64")
+    sim = AMRSim(cfg)          # 4 active blocks -> n_bucket = 128 (min)
+    sim._npad_hwm = 1024       # pretend a large peak happened
+    for _ in range(9):
+        sim._tables_version = -1
+        sim._refresh()
+        assert sim._npad_hwm == 1024
+    sim._tables_version = -1
+    sim._refresh()
+    assert sim._npad_hwm == 512      # one step down after 10 quiet
+
+    sim.reserve_blocks(400)          # floor 512: decay must stop here
+    for _ in range(25):
+        sim._tables_version = -1
+        sim._refresh()
+    assert sim._npad_hwm == 512
+
+
+def test_initialize_reserves_blocks():
+    """initialize() pre-sizes the bucket from the block estimate, so the
+    climb's executables compile once (the estimate must at least cover
+    the levelStart grid it starts from)."""
+    from cup2d_tpu.models import DiskShape
+    # 4x2 base blocks at level_start 2 = 128 active blocks: the estimate
+    # strictly exceeds the 128 default floor, so a vacuous pass is
+    # impossible — this fails if initialize() stops calling
+    # reserve_blocks
+    cfg = SimConfig(bpdx=4, bpdy=2, level_max=3, level_start=2,
+                    extent=1.0, dtype="float64", rtol=0.5, ctol=0.05)
+    sim = AMRSim(cfg, shapes=[DiskShape(0.06, 0.3, 0.25)])
+    sim.compute_forces_every = 0
+    sim.initialize()
+    assert sim._npad_floor >= 256
+    sim._refresh()
+    assert sim._npad_hwm >= sim._npad_floor
