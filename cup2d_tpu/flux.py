@@ -210,10 +210,11 @@ class _PoissonLabBuilder:
         return out
 
 
-def build_poisson_tables(forest: Forest, order: np.ndarray) -> HaloTables:
+def build_poisson_tables(forest: Forest, order: np.ndarray,
+                         topo=None) -> HaloTables:
     """g=1 scalar tables: `laplacian5(assemble_labs_ordered(x, t), 1)`
     is the reference's variable-resolution Poisson matrix A."""
-    return build_tables(forest, order, 1, False, 1,
+    return build_tables(forest, order, 1, False, 1, topo=topo,
                         builder_cls=_PoissonLabBuilder)
 
 

@@ -107,6 +107,81 @@ jax.tree_util.register_pytree_node(
 _TEMPLATE_CACHE: dict = {}
 
 
+class _TopoIndex:
+    """Dense per-level topology arrays for vectorized group keys,
+    template-trace verification and role instantiation.
+
+    The reference answers its per-block topology queries through hash
+    maps under OpenMP (treef/getf, main.cpp:672-738); the builder's
+    Python equivalents (forest.slot / owner_relation dict probes) were
+    the regrid-time bottleneck at 1e4+ blocks. A level-l grid has
+    (bpdx<<l) x (bpdy<<l) positions — small enough (sum ~4/3 * finest)
+    to materialize as flat arrays once per regrid, turning every query
+    batch into one fancy-indexed gather."""
+
+    def __init__(self, forest: Forest, order: np.ndarray):
+        cfg = forest.cfg
+        L = cfg.level_max
+        self.lmax = L
+        self.nbx = np.array([cfg.bpdx << l for l in range(L)], np.int64)
+        self.nby = np.array([cfg.bpdy << l for l in range(L)], np.int64)
+        sizes = self.nbx * self.nby
+        self.off = np.zeros(L + 1, np.int64)
+        np.cumsum(sizes, out=self.off[1:])
+        tot = int(self.off[-1])
+        self.slot = np.full(tot, -1, np.int32)
+        lv = forest.level[order].astype(np.int64)
+        bi = forest.bi[order].astype(np.int64)
+        bj = forest.bj[order].astype(np.int64)
+        self.slot[self.off[lv] + bj * self.nbx[lv] + bi] = order
+
+        # owner_relation codes per position: 0 active, -1 refined,
+        # -2 parent active, -3 nothing (forest.owner_relation)
+        self.rel = np.full(tot, -3, np.int8)
+        act = [self.slot[self.off[l]:self.off[l + 1]].reshape(
+            int(self.nby[l]), int(self.nbx[l])) >= 0 for l in range(L)]
+        for l in range(L):
+            r = np.full(act[l].shape, -3, np.int8)
+            if l + 1 < L:
+                a1 = act[l + 1]
+                refined = (a1.reshape(int(self.nby[l]), 2,
+                                      int(self.nbx[l]), 2)
+                           .any(axis=(1, 3)))
+                r[refined] = -1
+            if l > 0:
+                pa = np.repeat(np.repeat(act[l - 1], 2, 0), 2, 1)
+                r[pa & (r == -3)] = -2
+            r[act[l]] = 0
+            self.rel[self.off[l]:self.off[l + 1]] = r.ravel()
+
+    def _flat(self, l, i, j):
+        """Flat index + validity mask for (possibly out-of-range)
+        coordinate arrays; invalid positions index 0 with mask False."""
+        ok = (l >= 0) & (l < self.lmax)
+        lc = np.clip(l, 0, self.lmax - 1)
+        ok &= (i >= 0) & (i < self.nbx[lc]) & (j >= 0) & (j < self.nby[lc])
+        idx = np.where(ok, self.off[lc] + j * self.nbx[lc] + i, 0)
+        return idx, ok
+
+    def slot_at(self, l, i, j):
+        idx, ok = self._flat(l, i, j)
+        return np.where(ok, self.slot[idx], -1)
+
+    def rel_at(self, l, i, j):
+        idx, ok = self._flat(l, i, j)
+        return np.where(ok, self.rel[idx], np.int8(-3))
+
+    def abs_of(self, l0, bi0, bj0, dl, ri, rj):
+        """Vectorized _abs_of over member arrays [M] x rel arrays [T]:
+        returns [M, T] absolute (l, i, j)."""
+        al = l0[:, None] + dl[None, :]
+        up = np.maximum(dl, 0)[None, :]
+        dn = np.maximum(-dl, 0)[None, :]
+        ai = (bi0[:, None] << up >> dn) + ri[None, :]
+        aj = (bj0[:, None] << up >> dn) + rj[None, :]
+        return al, ai, aj
+
+
 def _rel_of(l, bi, bj, sl, si, sj):
     """Relative coords of source block (sl, si, sj) wrt block (l, bi, bj).
     dl >= -1 always (the builder only reaches the parent level)."""
@@ -166,7 +241,8 @@ def _block_rows(forest, builder, s, ordpos, L, bs, dim):
 
 
 def build_tables(forest: Forest, order: np.ndarray, g: int,
-                 tensorial: bool, dim: int, builder_cls=None) -> HaloTables:
+                 tensorial: bool, dim: int, builder_cls=None,
+                 topo: "_TopoIndex | None" = None) -> HaloTables:
     """Build gather tables for all ghost cells of all active blocks.
 
     The expression builder is O(ghost cells x interpolation depth) of
@@ -175,13 +251,15 @@ def build_tables(forest: Forest, order: np.ndarray, g: int,
     only on its LOCAL pattern: wall sides, position parity within the
     parent, and the refinement relations of every block the builder
     consults — not on absolute position or level (the weights carry no
-    h). So blocks are grouped by a cheap 3x3-relation key, the
-    expressions are built ONCE per group (on a recording view that
-    captures the full query trace), members verify the trace with plain
-    dict lookups (guarding rare deeper-refinement differences the key
-    can't see — those fall back to the naive path), and instantiation
-    is a numpy role->slot gather. Typical adapted forests have tens of
-    distinct patterns across thousands of blocks.
+    h). So blocks are grouped by a cheap 3x3-relation key (computed
+    vectorized over a dense per-level topology index, _TopoIndex), the
+    expressions are built ONCE per distinct pattern (on a recording
+    view that captures the full query trace), every member verifies the
+    trace in one batched gather (guarding rare deeper-refinement
+    differences the key can't see — each such variant gets its own
+    cached template), and instantiation is a numpy role->slot gather.
+    Typical adapted forests have tens of distinct patterns across
+    thousands of blocks.
 
     ``builder_cls`` swaps the ghost-expression specification: the
     default `_LabBuilder` is the reference BlockLab; `flux.py` passes a
@@ -189,10 +267,9 @@ def build_tables(forest: Forest, order: np.ndarray, g: int,
     (same (forest, g, tensorial, dim) constructor + `block_ghosts`).
 
     Templates are memoized ACROSS regrids (module cache keyed by the
-    position-independent group key): adapted forests have many
-    singleton patterns per regrid (measured: 102 groups over 254 blocks
-    around a body), but the same patterns recur at every regrid, so
-    steady-state rebuilds skip almost all expression construction. The
+    position-independent group key, holding one template per observed
+    deep variant): the same patterns recur at every regrid, so
+    steady-state rebuilds skip all expression construction. The
     per-member trace verification still runs, so a cached template is
     never applied to a block whose deeper neighborhood differs.
     """
@@ -206,22 +283,36 @@ def build_tables(forest: Forest, order: np.ndarray, g: int,
     n_act = len(order)
     lv, bia, bja = forest.level, forest.bi, forest.bj
 
-    # ---- group by local-pattern key --------------------------------------
-    groups: dict[tuple, list[int]] = {}
-    meta = []
-    for ordpos, s in enumerate(order):
-        l, bi, bj = int(lv[s]), int(bia[s]), int(bja[s])
-        meta.append((int(s), l, bi, bj))
-        nbx, nby = forest.nblocks_at(l)
-        rels = tuple(
-            forest.owner_relation(l, bi + cx, bj + cy)
-            for cy in (-1, 0, 1) for cx in (-1, 0, 1)
-            if not (cx == 0 and cy == 0))
-        key = (bi & 1, bj & 1, bi == 0, bi == nbx - 1, bj == 0,
-               bj == nby - 1, rels)
-        groups.setdefault(key, []).append(ordpos)
+    # ---- group by local-pattern key (vectorized over the topo index;
+    # callers building several table sets per regrid pass one shared
+    # index instead of rebuilding it per call) ------------------------------
+    if topo is None:
+        topo = _TopoIndex(forest, order)
+    lvo = lv[order].astype(np.int64)
+    bio = bia[order].astype(np.int64)
+    bjo = bja[order].astype(np.int64)
+    nbxv = np.int64(forest.cfg.bpdx) << lvo
+    nbyv = np.int64(forest.cfg.bpdy) << lvo
+    keyv = ((bio & 1)
+            | (bjo & 1) << 1
+            | (bio == 0).astype(np.int64) << 2
+            | (bio == nbxv - 1).astype(np.int64) << 3
+            | (bjo == 0).astype(np.int64) << 4
+            | (bjo == nbyv - 1).astype(np.int64) << 5)
+    shift = 6
+    for cy in (-1, 0, 1):
+        for cx in (-1, 0, 1):
+            if cx == 0 and cy == 0:
+                continue
+            r = topo.rel_at(lvo, bio + cx, bjo + cy).astype(np.int64)
+            keyv |= (-r) << shift     # rel in {0,-1,-2,-3} -> 2 bits
+            shift += 2
+    uniq, inv = np.unique(keyv, return_inverse=True)
+    by_group = np.argsort(inv, kind="stable")
+    bounds = np.searchsorted(inv[by_group], np.arange(len(uniq) + 1))
+    groups = {int(uniq[q]): by_group[bounds[q]:bounds[q + 1]]
+              for q in range(len(uniq))}
 
-    naive = builder_cls(forest, g, tensorial, dim)
     # accumulators: simple rows (dest, src, sign) / general rows
     sd_parts, ss_parts, sg_parts = [], [], []
     gd_parts, gi_parts, gw_parts = [], [], []
@@ -271,126 +362,139 @@ def build_tables(forest: Forest, order: np.ndarray, g: int,
                 np.asarray(s_sign, np.float64).reshape(len(s_dest), dim),
                 np.asarray(g_dest, np.int64), role_m, cell_m, w_m, valid)
 
+    def make_template(rep: int):
+        """Record + classify the ghost expressions of block ``rep``."""
+        s0 = int(order[rep])
+        l0, bi0, bj0 = int(lvo[rep]), int(bio[rep]), int(bjo[rep])
+        rec = _RecordingForest(forest, l0, bi0, bj0)
+        exprs = builder_cls(rec, g, tensorial, dim).block_ghosts(s0)
+        (roles, s_dest, s_role, s_cell, s_sign,
+         g_dest, role_m, cell_m, w_m, valid) = classify_template(
+            exprs, l0, bi0, bj0)
+        role_arr = np.array(list(roles.keys()), np.int64).reshape(
+            len(roles), 3)
+        tr = list(rec.trace.items())
+        tr_kind = np.array([0 if k[0] == "s" else 1 for k, _ in tr],
+                           np.int8)
+        tr_rel = np.array([k[1:] for k, _ in tr],
+                          np.int64).reshape(len(tr), 3)
+        tr_ans = np.array([int(v) for _, v in tr], np.int64)
+        return (role_arr, s_dest, s_role, s_cell, s_sign,
+                g_dest, role_m, cell_m, w_m, valid,
+                tr_kind, tr_rel, tr_ans)
+
     for key, members in groups.items():
-        rep = members[0]
-        cached = _TEMPLATE_CACHE.get(cache_base + (key,))
-        if cached is None:
-            s0, l0, bi0, bj0 = meta[rep]
-            rec = _RecordingForest(forest, l0, bi0, bj0)
-            exprs = builder_cls(rec, g, tensorial, dim).block_ghosts(s0)
-            (roles, s_dest, s_role, s_cell, s_sign,
-             g_dest, role_m, cell_m, w_m, valid) = classify_template(
-                exprs, l0, bi0, bj0)
-            role_list = list(roles.keys())
-            trace_items = list(rec.trace.items())
+        # each key holds a LIST of templates: the common pattern plus
+        # any deeper-refinement variants the key can't distinguish.
+        # Every member instantiates from the first template whose full
+        # topology trace it matches; members matching none get their own
+        # template appended (a member always matches the template built
+        # from itself, so the loop always terminates). This replaces the
+        # r2 per-regrid naive fallback — deep variants now cost the
+        # expression build ONCE instead of at every regrid.
+        cands = _TEMPLATE_CACHE.get(cache_base + (key,))
+        if cands is None:
             # bounded FIFO: evict oldest (insertion-ordered dict) so the
             # steady-state hot set survives the cap, unlike a clear()
             while len(_TEMPLATE_CACHE) >= 2048:
                 del _TEMPLATE_CACHE[next(iter(_TEMPLATE_CACHE))]
-            _TEMPLATE_CACHE[cache_base + (key,)] = (
-                role_list, s_dest, s_role, s_cell, s_sign,
-                g_dest, role_m, cell_m, w_m, valid, trace_items)
-            from_cache = False
-        else:
-            (role_list, s_dest, s_role, s_cell, s_sign,
-             g_dest, role_m, cell_m, w_m, valid, trace_items) = cached
-            from_cache = True
+            cands = _TEMPLATE_CACHE[cache_base + (key,)] = []
 
-        # verify each member's trace; mismatches take the naive path.
-        # A freshly built template skips its own rep (the trace IS the
-        # rep's); a cached one must verify every member, rep included.
-        ok_members = []
-        for ordpos in members:
-            s, l, bi, bj = meta[ordpos]
-            if from_cache or ordpos != rep:
-                ok = True
-                for (kind, dl, ri, rj), ans in trace_items:
-                    al, ai, aj = _abs_of(l, bi, bj, dl, ri, rj)
-                    if kind == "s":
-                        got = forest.slot(al, ai, aj) >= 0
-                    else:
-                        got = forest.owner_relation(al, ai, aj)
-                    if got != ans:
-                        ok = False
-                        break
-                if not ok:
-                    # pattern deeper than the key sees — exact fallback:
-                    # build this block's own expressions and template
-                    ex = naive.block_ghosts(s)
-                    (own_roles, fsd, fsr, fsc, fss,
-                     fgd, frm, fcm, fwm, fva) = classify_template(
-                        ex, l, bi, bj)
-                    rs = np.asarray(
-                        [forest.blocks[_abs_of(l, bi, bj, *rel)]
-                         for rel in own_roles], np.int64)
-                    base = ordpos * L * L
-                    sd_parts.append(base + fsd)
-                    ss_parts.append(rs[fsr] * bs * bs + fsc)
-                    sg_parts.append(fss)
-                    gd_parts.append(base + fgd)
-                    gi_parts.append(
-                        np.where(fva, rs[frm] * bs * bs + fcm, 0))
-                    gw_parts.append(fwm)
-                    continue
-            ok_members.append(ordpos)
+        remaining = np.asarray(members)
+        ti = 0
+        while len(remaining):
+            if ti < len(cands):
+                tpl = cands[ti]
+                ti += 1
+            else:
+                # built from remaining[0], so it always matches at least
+                # that member — guaranteed progress. The 64-variant cap
+                # bounds pathological caches; uncached templates still
+                # serve the current call.
+                tpl = make_template(int(remaining[0]))
+                if len(cands) < 64:
+                    cands.append(tpl)
+                    ti += 1
+            (role_arr, s_dest, s_role, s_cell, s_sign,
+             g_dest, role_m, cell_m, w_m, valid,
+             tr_kind, tr_rel, tr_ans) = tpl
 
-        if not ok_members:
-            continue
-        # vectorized instantiation over the whole group
-        M = len(ok_members)
-        role_slots = np.empty((M, len(role_list)), np.int64)
-        bases = np.empty(M, np.int64)
-        for m, ordpos in enumerate(ok_members):
-            s, l, bi, bj = meta[ordpos]
-            bases[m] = ordpos * L * L
-            row = role_slots[m]
-            for q, rel in enumerate(role_list):
-                row[q] = forest.blocks[_abs_of(l, bi, bj, *rel)]
-        if len(s_dest):
-            sd_parts.append(
-                (bases[:, None] + s_dest[None, :]).reshape(-1))
-            ss_parts.append(
-                (role_slots[:, s_role] * bs * bs + s_cell).reshape(-1))
-            sg_parts.append(np.broadcast_to(
-                s_sign, (M,) + s_sign.shape).reshape(-1, dim))
-        if len(g_dest):
-            gd_parts.append(
-                (bases[:, None] + g_dest[None, :]).reshape(-1))
-            gi = np.where(valid[None],
-                          role_slots[:, role_m] * bs * bs + cell_m[None],
-                          0)
-            gi_parts.append(gi.reshape(-1, gi.shape[-1]))
-            gw_parts.append(np.broadcast_to(
-                w_m, (M,) + w_m.shape).reshape(-1, *w_m.shape[1:]))
+            # verify the topology trace of all remaining members against
+            # this template in one vectorized gather batch
+            l0v, b0v, c0v = lvo[remaining], bio[remaining], bjo[remaining]
+            al, ai, aj = topo.abs_of(
+                l0v, b0v, c0v, tr_rel[:, 0], tr_rel[:, 1], tr_rel[:, 2])
+            got = np.where(
+                tr_kind[None, :] == 0,
+                (topo.slot_at(al, ai, aj) >= 0).astype(np.int64),
+                topo.rel_at(al, ai, aj).astype(np.int64))
+            ok = (got == tr_ans[None, :]).all(axis=1)
+            rl, rxi, ryj = topo.abs_of(
+                l0v, b0v, c0v,
+                role_arr[:, 0], role_arr[:, 1], role_arr[:, 2])
+            role_slots_all = topo.slot_at(rl, rxi, ryj).astype(np.int64)
+            ok &= (role_slots_all >= 0).all(axis=1)
+            if not ok.any():
+                continue
 
-    # ---- concatenate, padding general rows to the global K ---------------
+            # vectorized instantiation over the matching members
+            M = int(ok.sum())
+            role_slots = role_slots_all[ok]
+            bases = remaining[ok].astype(np.int64) * (L * L)
+            if len(s_dest):
+                sd_parts.append(
+                    (bases[:, None] + s_dest[None, :]).reshape(-1))
+                ss_parts.append(
+                    (role_slots[:, s_role] * bs * bs + s_cell).reshape(-1))
+                sg_parts.append(np.broadcast_to(
+                    s_sign, (M,) + s_sign.shape).reshape(-1, dim))
+            if len(g_dest):
+                gd_parts.append(
+                    (bases[:, None] + g_dest[None, :]).reshape(-1))
+                gi = np.where(
+                    valid[None],
+                    role_slots[:, role_m] * bs * bs + cell_m[None],
+                    0)
+                gi_parts.append(gi.reshape(-1, gi.shape[-1]))
+                gw_parts.append(np.broadcast_to(
+                    w_m, (M,) + w_m.shape).reshape(-1, *w_m.shape[1:]))
+            remaining = remaining[~ok]
+
+    # ---- assemble, padding general rows to the global K ------------------
+    # single-pass preallocate-and-fill (cast on assignment): a
+    # concatenate-then-astype chain copies every big array twice and was
+    # ~40% of the warm rebuild
     f32 = jnp.dtype(forest.dtype).name
     kmax = max((a.shape[1] for a in gi_parts), default=1)
-    gi_parts = [np.pad(a, ((0, 0), (0, kmax - a.shape[1])))
-                for a in gi_parts]
-    gw_parts = [np.pad(a, ((0, 0), (0, kmax - a.shape[1]), (0, 0)))
-                for a in gw_parts]
 
-    def cat(parts, shape_tail, dtype):
-        if parts:
-            return np.ascontiguousarray(
-                np.concatenate(parts).astype(dtype))
-        return np.zeros((0,) + shape_tail, dtype)
+    def cat(parts, shape_tail, dtype, pad_k=False):
+        n = sum(p.shape[0] for p in parts)
+        out = np.zeros((n,) + shape_tail, dtype)
+        o = 0
+        for p in parts:
+            if pad_k:
+                out[o:o + p.shape[0], :p.shape[1]] = p
+            else:
+                out[o:o + p.shape[0]] = p
+            o += p.shape[0]
+        return out
 
     dest_s = cat(sd_parts, (), np.int32)
     src = cat(ss_parts, (), np.int32)
     sign = cat(sg_parts, (dim,), f32)
     dest = cat(gd_parts, (), np.int32)
-    idx = cat(gi_parts, (kmax,), np.int32)
-    w = cat(gw_parts, (kmax, dim), f32)
+    idx = cat(gi_parts, (kmax,), np.int32, pad_k=True)
+    w = cat(gw_parts, (kmax, dim), f32, pad_k=True)
 
     # remap to the SFC-ordered compact layout (for operands stored as
     # [n_active, BS, BS], e.g. the Poisson Krylov vectors)
-    ordpos_of = np.zeros(forest.capacity, np.int64)
-    ordpos_of[order] = np.arange(n_act)
+    ordpos_of = np.zeros(forest.capacity, np.int32)
+    ordpos_of[order] = np.arange(n_act, dtype=np.int32)
     bs2 = bs * bs
-    src_ord = (ordpos_of[src // bs2] * bs2 + src % bs2).astype(np.int32)
-    idx_ord = (ordpos_of[idx // bs2] * bs2 + idx % bs2).astype(np.int32)
+    sq, sr = np.divmod(src, bs2)
+    src_ord = ordpos_of[sq] * bs2 + sr
+    iq, ir = np.divmod(idx, bs2)
+    idx_ord = ordpos_of[iq] * bs2 + ir
     # HOST (numpy) leaves by design: tables are host-built metadata that
     # pad_tables post-processes and amr._refresh uploads in ONE async
     # device_put. Returning device arrays here made pad_tables pull
