@@ -44,10 +44,11 @@ from .config import SimConfig
 from .flux import apply_flux_corr, build_flux_corr, build_poisson_tables, \
     diffusive_deposits, divergence_deposits, gradient_deposits
 from .forest import Forest
-from .halo import assemble_labs, assemble_labs_ordered, build_tables, \
-    pad_tables
+from .halo import _TopoIndex, _bucket, assemble_labs, \
+    assemble_labs_ordered, build_tables, pad_tables
 from . import native
-from .ops.collision import collision_response, overlap_integrals
+from .ops.collision import merged_overlap_integrals, \
+    pairwise_collision_update
 from .ops.forces import surface_forces_blocks
 from .ops.obstacle import (
     chi_from_sdf,
@@ -205,21 +206,28 @@ class AMRSim(ShapeHostMixin):
         def padded(t):
             return pad_tables(t, n_pad)
 
+        # one dense topology index shared by all 6-8 table builds
+        topo = _TopoIndex(f, self._order)
         self._tables = {
-            "vec3": padded(build_tables(f, self._order, 3, True, 2)),
-            "vec1": padded(build_tables(f, self._order, 1, False, 2)),
-            "sca1": padded(build_tables(f, self._order, 1, False, 1)),
-            "vec1t": padded(build_tables(f, self._order, 1, True, 2)),
-            "sca1t": padded(build_tables(f, self._order, 1, True, 1)),
+            "vec3": padded(build_tables(f, self._order, 3, True, 2,
+                                        topo=topo)),
+            "vec1": padded(build_tables(f, self._order, 1, False, 2,
+                                        topo=topo)),
+            "sca1": padded(build_tables(f, self._order, 1, False, 1,
+                                        topo=topo)),
+            "vec1t": padded(build_tables(f, self._order, 1, True, 2,
+                                         topo=topo)),
+            "sca1t": padded(build_tables(f, self._order, 1, True, 1,
+                                         topo=topo)),
             # makeFlux variable-resolution Poisson rows (flux.py)
-            "pois": padded(build_poisson_tables(f, self._order)),
+            "pois": padded(build_poisson_tables(f, self._order, topo=topo)),
         }
         if self.shapes:
             # chi tagging (g=4 scalar) + force diagnostics (g=4 vector)
             self._tables["sca4t"] = padded(
-                build_tables(f, self._order, 4, True, 1))
+                build_tables(f, self._order, 4, True, 1, topo=topo))
             self._tables["vec4t"] = padded(
-                build_tables(f, self._order, 4, True, 2))
+                build_tables(f, self._order, 4, True, 2, topo=topo))
         # one async transfer for every table leaf (pad_tables returns
         # numpy on purpose; per-leaf jnp.asarray would synchronize per
         # array — ~14 s/regrid through the TPU tunnel, measured)
@@ -378,27 +386,16 @@ class AMRSim(ShapeHostMixin):
                 uvw.append(prescribed[k])
         uvw = jnp.stack(uvw)
 
-        # shape-shape collisions (main.cpp:6705-6943)
+        # shape-shape collisions (main.cpp:6705-6943): opponent-merged
+        # integrals in one field pass, impulses via lax.fori_loop —
+        # O(S*N) + O(1)-compile in the pair count (many-body ready)
         if S > 1:
-            colls = []
-            for i in range(S):
-                acc = jnp.zeros(7, dtype=v.dtype)
-                for j in range(S):
-                    if i == j:
-                        continue
-                    acc = acc + overlap_integrals(
-                        obs.chi_s[i], obs.chi_s[j], obs.sdf_s[i],
-                        obs.udef_s[i], uvw[i], obs.com[i], xc, yc)
-                colls.append(acc)
-            for i in range(S):
-                for j in range(i + 1, S):
-                    new_i, new_j, _hit = collision_response(
-                        colls[i], colls[j], uvw[i], uvw[j],
-                        obs.mass[i], obs.mass[j],
-                        obs.inertia[i], obs.inertia[j],
-                        obs.com[i], obs.com[j],
-                        self.shapes[i].length)
-                    uvw = uvw.at[i].set(new_i).at[j].set(new_j)
+            colls = merged_overlap_integrals(
+                obs.chi_s, obs.sdf_s, obs.udef_s, uvw, obs.com, xc, yc)
+            lengths = jnp.asarray(
+                [s.length for s in self.shapes], v.dtype)
+            uvw = pairwise_collision_update(
+                colls, uvw, obs.mass, obs.inertia, obs.com, lengths)
             for k in range(S):
                 if not self.shapes[k].free:
                     uvw = uvw.at[k].set(prescribed[k])
@@ -1013,8 +1010,8 @@ class AMRSim(ShapeHostMixin):
         f = self.forest
         ordpos = {int(s): k for k, s in enumerate(self._order)}
         R, G = len(refine_keys), len(groups)
-        Rp = max(4, 1 << max(0, (R - 1)).bit_length())
-        Gp = max(4, 1 << max(0, (G - 1)).bit_length())
+        Rp = _bucket(R, lo=4)
+        Gp = _bucket(G, lo=4)
 
         # host bookkeeping first: parents/siblings resolved BEFORE any
         # release; all allocations done (possibly growing the slot

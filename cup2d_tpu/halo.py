@@ -227,19 +227,6 @@ class _RecordingForest:
         return r
 
 
-def _block_rows(forest, builder, s, ordpos, L, bs, dim):
-    """Naive per-block path: expressions -> (dest, idx, w) row lists."""
-    exprs = builder.block_ghosts(int(s))
-    dest, idx_rows, w_rows = [], [], []
-    for (ly, lx), e in exprs.items():
-        dest.append(ordpos * L * L + ly * L + lx)
-        ks = list(e.items())
-        idx_rows.append([slot * bs * bs + cy * bs + cx
-                         for (slot, cy, cx), _ in ks])
-        w_rows.append([w for _, w in ks])
-    return dest, idx_rows, w_rows
-
-
 def build_tables(forest: Forest, order: np.ndarray, g: int,
                  tensorial: bool, dim: int, builder_cls=None,
                  topo: "_TopoIndex | None" = None) -> HaloTables:

@@ -31,7 +31,8 @@ import numpy as np
 
 from .config import SimConfig
 from .models import DiskShape, FishShape
-from .ops.collision import collision_response, overlap_integrals
+from .ops.collision import merged_overlap_integrals, \
+    pairwise_collision_update
 from .ops.forces import surface_forces
 from .ops.obstacle import (
     chi_from_sdf,
@@ -230,25 +231,12 @@ class Simulation(ShapeHostMixin):
         # reference's collisions[i] struct), then pairwise e=1 impulses
         # applied sequentially in pair order
         if S > 1:
-            colls = []
-            for i in range(S):
-                acc = jnp.zeros(7, dtype=g.dtype)
-                for j in range(S):
-                    if i == j:
-                        continue
-                    acc = acc + overlap_integrals(
-                        obs.chi_s[i], obs.chi_s[j], obs.sdf_s[i],
-                        obs.udef_s[i], uvw[i], obs.com[i], x, y)
-                colls.append(acc)
-            for i in range(S):
-                for j in range(i + 1, S):
-                    new_i, new_j, _hit = collision_response(
-                        colls[i], colls[j], uvw[i], uvw[j],
-                        obs.mass[i], obs.mass[j],
-                        obs.inertia[i], obs.inertia[j],
-                        obs.com[i], obs.com[j],
-                        self.shapes[i].length)
-                    uvw = uvw.at[i].set(new_i).at[j].set(new_j)
+            colls = merged_overlap_integrals(
+                obs.chi_s, obs.sdf_s, obs.udef_s, uvw, obs.com, x, y)
+            lengths = jnp.asarray(
+                [s.length for s in self.shapes], g.dtype)
+            uvw = pairwise_collision_update(
+                colls, uvw, obs.mass, obs.inertia, obs.com, lengths)
             # prescribed-motion shapes are immovable: restore them
             for k in range(S):
                 if not self.shapes[k].free:
