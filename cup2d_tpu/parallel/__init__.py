@@ -14,3 +14,5 @@ from .mesh import (  # noqa: F401
     shard_state,
     ShardedUniformSim,
 )
+from .forest_mesh import ShardedAMRSim  # noqa: F401
+from .launch import global_mesh, init_distributed  # noqa: F401

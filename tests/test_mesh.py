@@ -75,3 +75,16 @@ def test_sharded_poisson_iterates():
     diag = sh.advance(1)
     assert int(diag["poisson_iters"]) > 0
     assert bool(jnp.all(jnp.isfinite(sh.state.vel)))
+
+
+def test_launch_single_host_noop_and_global_mesh():
+    """init_distributed on a single-host run is a no-op returning
+    process 0; global_mesh covers all (virtual) devices and plugs
+    straight into ShardedUniformSim."""
+    import jax
+    from cup2d_tpu.parallel import global_mesh, init_distributed
+
+    assert init_distributed() == 0
+    mesh = global_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    assert mesh.axis_names == ("x",)
