@@ -130,6 +130,7 @@ class AMRSim(ShapeHostMixin):
             static_argnames=("exact_poisson", "with_forces"))
         self._next_dt = None
         self._next_dt_version = -1
+        self._next_umax = None   # survives regrids (see step_once)
         self._raster_jit = jax.jit(self._rasterize_impl)
         self._vorticity_jit = jax.jit(self._vorticity_impl)
         self._tags_jit = jax.jit(self._tags_impl)
@@ -206,33 +207,39 @@ class AMRSim(ShapeHostMixin):
         def padded(t):
             return pad_tables(t, n_pad)
 
+        tm = self.timers or NULL_TIMERS
         # one dense topology index shared by all 6-8 table builds
         topo = _TopoIndex(f, self._order)
-        self._tables = {
-            "vec3": padded(build_tables(f, self._order, 3, True, 2,
-                                        topo=topo)),
-            "vec1": padded(build_tables(f, self._order, 1, False, 2,
-                                        topo=topo)),
-            "sca1": padded(build_tables(f, self._order, 1, False, 1,
-                                        topo=topo)),
-            "vec1t": padded(build_tables(f, self._order, 1, True, 2,
-                                         topo=topo)),
-            "sca1t": padded(build_tables(f, self._order, 1, True, 1,
-                                         topo=topo)),
-            # makeFlux variable-resolution Poisson rows (flux.py)
-            "pois": padded(build_poisson_tables(f, self._order, topo=topo)),
-        }
-        if self.shapes:
-            # chi tagging (g=4 scalar) + force diagnostics (g=4 vector)
-            self._tables["sca4t"] = padded(
-                build_tables(f, self._order, 4, True, 1, topo=topo))
-            self._tables["vec4t"] = padded(
-                build_tables(f, self._order, 4, True, 2, topo=topo))
+        with tm.phase("tables/build"):
+            self._tables = {
+                "vec3": padded(build_tables(f, self._order, 3, True, 2,
+                                            topo=topo)),
+                "vec1": padded(build_tables(f, self._order, 1, False, 2,
+                                            topo=topo)),
+                "sca1": padded(build_tables(f, self._order, 1, False, 1,
+                                            topo=topo)),
+                "vec1t": padded(build_tables(f, self._order, 1, True, 2,
+                                             topo=topo)),
+                "sca1t": padded(build_tables(f, self._order, 1, True, 1,
+                                             topo=topo)),
+                # makeFlux variable-resolution Poisson rows (flux.py)
+                "pois": padded(
+                    build_poisson_tables(f, self._order, topo=topo)),
+            }
+            if self.shapes:
+                # chi tagging (g=4 scalar) + forces (g=4 vector)
+                self._tables["sca4t"] = padded(
+                    build_tables(f, self._order, 4, True, 1, topo=topo))
+                self._tables["vec4t"] = padded(
+                    build_tables(f, self._order, 4, True, 2, topo=topo))
         # one async transfer for every table leaf (pad_tables returns
         # numpy on purpose; per-leaf jnp.asarray would synchronize per
         # array — ~14 s/regrid through the TPU tunnel, measured)
-        self._tables = jax.device_put(self._tables)
-        self._corr = build_flux_corr(f, self._order, n_pad=n_pad)
+        with tm.phase("tables/put"):
+            self._tables = jax.device_put(self._tables)
+        with tm.phase("tables/corr"):
+            self._corr = build_flux_corr(f, self._order, n_pad=n_pad,
+                                         topo=topo)
         h = f.h_per_block(self._order)
         hp = np.concatenate([h, np.ones(n_pad - n_real)])
         hsqp = np.concatenate([h * h, np.zeros(n_pad - n_real)])
@@ -696,20 +703,24 @@ class AMRSim(ShapeHostMixin):
         f.fields["chi"] = f.fields["chi"].at[self._order_j].set(
             obs.chi[:, None])
 
+    def _window_blocks_estimate(self, s) -> int:
+        """Finest-level blocks covering shape ``s``'s rasterization
+        window (the chi-tag region that ends up at level_max-1)."""
+        cfg = self.cfg
+        h_fin = cfg.h_at(cfg.level_max - 1)
+        r = 0.625 * s.length + 12.0 * cfg.min_h
+        return int(np.ceil(2.0 * r / (cfg.bs * h_fin))) ** 2
+
     def _estimate_blocks(self) -> int:
         """Upper-ish estimate of the active block count the init climb
         will reach: the full levelStart grid (the climb's starting point
         and usual peak) plus, per shape, twice the finest-level blocks
-        covering its rasterization window (the chi-tag region that ends
-        up at level_max-1, with the factor 2 absorbing the coarser-level
-        pyramid and the 2:1 halo rings)."""
+        covering its rasterization window (the factor 2 absorbing the
+        coarser-level pyramid and the 2:1 halo rings)."""
         cfg = self.cfg
         est = cfg.bpdx * cfg.bpdy << (2 * cfg.level_start)
-        h_fin = cfg.h_at(cfg.level_max - 1)
         for s in self.shapes:
-            r = 0.625 * s.length + 12.0 * cfg.min_h
-            nb = int(np.ceil(2.0 * r / (cfg.bs * h_fin))) ** 2
-            est += 2 * nb
+            est += 2 * self._window_blocks_estimate(s)
         return est
 
     def initialize(self):
@@ -724,6 +735,13 @@ class AMRSim(ShapeHostMixin):
             return
         cfg = self.cfg
         self.reserve_blocks(self._estimate_blocks())
+        # pre-size the per-shape rasterization windows the same way:
+        # every window-capacity crossing during the climb recompiles the
+        # megastep (the biggest executable in the repo)
+        for k, s in enumerate(self.shapes):
+            want = int(2.6 * self._window_blocks_estimate(s)) + 16
+            self._wcap[k] = max(
+                self._wcap[k], 1 << max(0, (want - 1)).bit_length())
         for s in self.shapes:
             s.advect(0.0, cfg.extents)
             s.midline(0.0)
@@ -799,6 +817,22 @@ class AMRSim(ShapeHostMixin):
             if self._next_dt is not None and \
                     self._next_dt_version == f.version:
                 dt = min(self._next_dt, self._kinematic_dt_cap())
+            elif self._next_umax is not None:
+                # a regrid invalidated the layout, not the physics: the
+                # velocity field is the same water, re-gridded (2nd-order
+                # prolongation can overshoot umax by a few %, well inside
+                # the CFL-0.5 slack). Only hmin can change; recompute dt
+                # from the cached end-state umax through the SAME shared
+                # arithmetic — one scalar round trip instead of a full
+                # field reduction + compile after every adapt (9.5 s/call
+                # measured on the canonical case through the tunnel).
+                with tm.phase("dt"):
+                    hmin = jnp.asarray(
+                        self.cfg.h_at(int(f.level[self._order].max())),
+                        f.dtype)
+                    dt = min(float(self._dt_from_umax(
+                        jnp.asarray(self._next_umax, f.dtype), hmin)),
+                        self._kinematic_dt_cap())
             else:
                 with tm.phase("dt"):
                     dt = min(self.compute_dt(), self._kinematic_dt_cap())
@@ -844,6 +878,7 @@ class AMRSim(ShapeHostMixin):
                 s.u, s.v, s.omega = uvw_np[k]
         self._next_dt = float(dt_next)
         self._next_dt_version = f.version
+        self._next_umax = float(diag["umax"])
         if with_forces:
             with tm.phase("forces"):
                 self._record_forces(forces)
@@ -1010,8 +1045,17 @@ class AMRSim(ShapeHostMixin):
         f = self.forest
         ordpos = {int(s): k for k, s in enumerate(self._order)}
         R, G = len(refine_keys), len(groups)
-        Rp = _bucket(R, lo=4)
-        Gp = _bucket(G, lo=4)
+        # one executable per pad bucket: padding refine/compress rows to
+        # n_pad/4 (G can never exceed it — 4 siblings per group; R can
+        # only during mass refinement, which falls back to its own
+        # bucket) keeps steady-state regrids on a single compiled
+        # executable instead of one per (Rp, Gp) combination — each
+        # extra combination cost a full XLA compile of the fused
+        # prolong+restrict program (~10-30 s through the remote-compile
+        # tunnel, measured on the canonical case)
+        cap = max(32, self._npad_hwm // 4)
+        Rp = cap if R <= cap else _bucket(R, lo=4)
+        Gp = cap if G <= cap else _bucket(G, lo=4)
 
         # host bookkeeping first: parents/siblings resolved BEFORE any
         # release; all allocations done (possibly growing the slot

@@ -159,7 +159,9 @@ class _TopoIndex:
         coordinate arrays; invalid positions index 0 with mask False."""
         ok = (l >= 0) & (l < self.lmax)
         lc = np.clip(l, 0, self.lmax - 1)
-        ok &= (i >= 0) & (i < self.nbx[lc]) & (j >= 0) & (j < self.nby[lc])
+        # not &=: l may broadcast against wider i/j (e.g. [M,1] vs [M,T])
+        ok = ok & (i >= 0) & (i < self.nbx[lc]) \
+            & (j >= 0) & (j < self.nby[lc])
         idx = np.where(ok, self.off[lc] + j * self.nbx[lc] + i, 0)
         return idx, ok
 

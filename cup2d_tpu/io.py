@@ -219,6 +219,13 @@ def load_checkpoint(dirpath: str, sim) -> None:
         meta = json.load(f)
     sim.time = float(meta["time"])
     sim.step_count = int(meta["step_count"])
+    # cached next-dt state belongs to the ABANDONED trajectory: a stale
+    # umax/dt surviving the restore would fork the restart from the
+    # uninterrupted run (the bit-exact-resume contract, tests/test_io)
+    for attr, cleared in (("_next_dt", None), ("_next_umax", None),
+                          ("_next_dt_version", -1)):
+        if hasattr(sim, attr):
+            setattr(sim, attr, cleared)
     shapes_path = os.path.join(dirpath, "shapes.pkl")
     if hasattr(sim, "shapes") and os.path.exists(shapes_path):
         with open(shapes_path, "rb") as f:

@@ -1,0 +1,65 @@
+"""Offline field renderer: per-cell quads colored by |attr|^2 -> PNG.
+
+The reference ships an offline plotter with the same contract
+(`/root/reference/post.py`: memmap the .xyz.raw/.attr.raw pair, draw
+each cell's rectangle colored by the squared magnitude of its
+attribute, save PNG at high dpi). This renderer reads the identical
+byte-compatible dump format through `io.read_dump` and draws the quads
+as one matplotlib PolyCollection — mixed-level AMR dumps render
+naturally because the format is per-cell quads (each cell carries its
+own geometry, so resolution can vary freely).
+
+Usage:  python -m cup2d_tpu.post out/vel.0000001234.xdmf2 [...]
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from .io import read_dump
+
+
+def render(path: str, png_path: str | None = None,
+           cmap: str = "viridis", dpi: int = 400) -> str:
+    """Render one dump (any of the .xdmf2/.xyz.raw/.attr.raw paths or
+    the bare prefix) to PNG; returns the written path."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from matplotlib.collections import PolyCollection
+
+    for suf in (".xdmf2", ".attr.raw", ".xyz.raw"):
+        if path.endswith(suf):
+            path = path[: -len(suf)]
+    time, xyz, attr = read_dump(path)
+    # xyz: [ncell, 4, 2] quad corners; attr: [ncell, 3] (u, v, 0)
+    val = np.sum(attr.astype(np.float64) ** 2, axis=1)
+    fig, ax = plt.subplots()
+    pc = PolyCollection(xyz, array=val, cmap=cmap, edgecolors="none")
+    ax.add_collection(pc)
+    ax.set_xlim(float(xyz[..., 0].min()), float(xyz[..., 0].max()))
+    ax.set_ylim(float(xyz[..., 1].min()), float(xyz[..., 1].max()))
+    ax.set_aspect("equal")
+    ax.set_title(f"t = {time:g}")
+    fig.colorbar(pc, ax=ax, shrink=0.7)
+    out = png_path or (path + ".png")
+    fig.savefig(out, dpi=dpi, bbox_inches="tight")
+    plt.close(fig)
+    return out
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args:
+        print("usage: python -m cup2d_tpu.post <dump>[.xdmf2] ...",
+              file=sys.stderr)
+        return 2
+    for a in args:
+        print(render(a))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

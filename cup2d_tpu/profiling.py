@@ -67,9 +67,11 @@ def throughput(sim) -> dict:
     else:
         cells = sim.grid.nx * sim.grid.ny
     wall = getattr(sim, "timers", None)
-    # phases are non-nested by construction (adapt() refreshes tables
-    # BEFORE opening its phase), so the plain sum is the wall total
-    total = sum(wall.acc.values()) if wall else float("nan")
+    # top-level phases are non-nested by construction (adapt() refreshes
+    # tables BEFORE opening its phase); "a/b"-named sub-phases break the
+    # parent down and are excluded from the wall total
+    total = (sum(v for k, v in wall.acc.items() if "/" not in k)
+             if wall else float("nan"))
     return {
         "cells": cells,
         "steps": sim.step_count,

@@ -117,3 +117,50 @@ def test_cli_driver_smoke(tmp_path):
     lines = open(tmp_path / "forces.csv").read().splitlines()
     assert lines[0].startswith("time,shape,perimeter")
     assert len(lines) > 1
+
+
+def test_post_renders_dump_png(tmp_path):
+    """The offline renderer turns a dump pair into a PNG (the
+    reference's post-processing step, post.py)."""
+    import jax.numpy as jnp
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.io import dump_uniform
+    from cup2d_tpu.post import render
+    from cup2d_tpu.uniform import UniformGrid, taylor_green_state
+
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=1, level_start=0,
+                    extent=1.0, dtype="float64")
+    grid = UniformGrid(cfg, level=1)
+    state = taylor_green_state(grid)
+    path = str(tmp_path / "vel.0000000001")
+    dump_uniform(path, 0.25, state.vel, grid.h)
+    png = render(path + ".xdmf2", dpi=80)
+    import os
+    assert os.path.exists(png) and os.path.getsize(png) > 1000
+
+
+def test_restore_clears_cached_dt_state():
+    """Restoring into a sim that already stepped must not reuse the
+    abandoned trajectory's cached umax/dt (it would fork the restart
+    from the uninterrupted run)."""
+    from cup2d_tpu.amr import AMRSim
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.io import load_checkpoint, save_checkpoint
+    from cup2d_tpu.models import DiskShape
+
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=3, level_start=1,
+                    extent=1.0, dtype="float64", nu=1e-3, lam=1e5,
+                    rtol=0.5, ctol=0.05, max_poisson_iterations=40,
+                    poisson_tol=1e-4, poisson_tol_rel=1e-3)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        sim = AMRSim(cfg, shapes=[DiskShape(0.08, 0.4, 0.5,
+                                            prescribed=(0.2, 0.0))])
+        sim.compute_forces_every = 0
+        sim.initialize()
+        save_checkpoint(d + "/ck", sim)
+        sim.step_once()
+        assert sim._next_umax is not None
+        load_checkpoint(d + "/ck", sim)
+        assert sim._next_umax is None
+        assert sim._next_dt is None
