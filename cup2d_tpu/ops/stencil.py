@@ -55,6 +55,14 @@ _WENO_EPS = 1e-6
 
 
 def _weno5_weights(b1, b2, b3, g1, g2, g3):
+    # deliberately the textbook ratio form. The single-divide variant
+    # (n_i = g_i * prod_{j!=i} (b_j+e)^2, one normalization divide) is
+    # 17% faster on the STANDALONE advection op but does not move the
+    # fused full step at all (XLA hides the divides behind HBM traffic
+    # there), and its quartic products overflow f32 at b ~ 2e9 versus
+    # ~1e19 here — a transiently unstable run would NaN instead of
+    # producing large-but-finite values the CFL/penalization machinery
+    # can recover from. Measurements in BASELINE.md.
     w1 = g1 / (b1 + _WENO_EPS) ** 2
     w2 = g2 / (b2 + _WENO_EPS) ** 2
     w3 = g3 / (b3 + _WENO_EPS) ** 2
@@ -116,7 +124,13 @@ def advect_diffuse_rhs(vlab: jnp.ndarray, g: int, h, nu, dt):
 def advect_diffuse_core(vlab: jnp.ndarray, g: int, afac, dfac):
     """Same, with the scale factors precomputed — shared verbatim by the
     XLA path above and the Pallas kernel (ops/pallas_kernels.py), so the
-    two can never drift numerically."""
+    two can never drift numerically.
+
+    Deliberately the per-cell form: evaluating each reconstruction once
+    on a one-cell-extended range and differencing by shift halves the
+    arithmetic on paper but measured 26% SLOWER at 8192^2 — the
+    odd-width (n+1) intermediates misalign XLA's (8, 128) lane tiling
+    and the relayouts cost more than the saved flops."""
     assert g >= 3
     u = shift(vlab, g, 0, 0)
     wind_u = u[..., 0:1, :, :]  # u component drives x-derivatives
