@@ -198,6 +198,8 @@ def run_size(size: int, n_warmup: int, n_steps: int):
 
 
 def main():
+    from cup2d_tpu.cache import enable_compilation_cache
+    enable_compilation_cache()
     size = int(os.environ.get("BENCH_SIZE", "8192"))
     n_warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     n_steps = int(os.environ.get("BENCH_STEPS", "10"))

@@ -26,20 +26,7 @@ from .config import CommandlineParser, SimConfig
 from .io import dump_forest, dump_uniform, load_checkpoint, save_checkpoint
 
 
-def enable_compilation_cache():
-    """Persistent XLA compilation cache: adaptive runs compile one
-    executable per (bucket, window-capacity) combination — tens of
-    multi-second TPU compiles that are identical across process
-    restarts of the same case."""
-    import jax
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get("CUP2D_CACHE",
-                           os.path.expanduser("~/.cache/cup2d_tpu_xla")))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass  # older jax without the knob: run uncached
+from .cache import enable_compilation_cache
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,24 @@
+"""Persistent XLA compilation cache for every entry point.
+
+Adaptive runs compile one executable per (bucket, window-capacity)
+combination — tens of multi-second TPU compiles that are identical
+across process restarts of the same case. The CLI, bench and driver
+entry points all funnel through here; library users can call it once
+before building a sim. Safe to call repeatedly.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache() -> None:
+    import jax
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("CUP2D_CACHE",
+                           os.path.expanduser("~/.cache/cup2d_tpu_xla")))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without the knob: run uncached
