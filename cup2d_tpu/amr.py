@@ -916,52 +916,49 @@ class AMRSim(ShapeHostMixin):
                 self._tables["vec1"]))[:self._n_real]
         order = self._order
 
-        # 1 = refine, -1 = compress, 0 = leave
-        state = {}
-        for k, s in enumerate(order):
-            key = (int(f.level[s]), int(f.bi[s]), int(f.bj[s]))
-            if tags[k] > cfg.rtol and key[0] < cfg.level_max - 1:
-                state[key] = 1
-            elif tags[k] < cfg.ctol and key[0] > 0:
-                state[key] = -1
-            else:
-                state[key] = 0
-        if not any(state.values()):
+        # 1 = refine, -1 = compress, 0 = leave — vectorized over the
+        # ordered block arrays (a per-block Python dict was O(n) host
+        # time per adapt; only the final refine/group LISTS — small —
+        # are materialized for the regrid bookkeeping)
+        lv = f.level[order].astype(np.int64)
+        biv = f.bi[order].astype(np.int64)
+        bjv = f.bj[order].astype(np.int64)
+        st = np.where(
+            (tags > cfg.rtol) & (lv < cfg.level_max - 1), 1,
+            np.where((tags < cfg.ctol) & (lv > 0), -1, 0)
+        ).astype(np.int8)
+        if not st.any():
             return False
 
-        self._fix_states(state)
+        self._fix_states(lv, biv, bjv, st)
 
-        refine = [k for k, v in state.items() if v == 1]
-        groups = self._compress_groups(state)
+        refine = [(int(lv[k]), int(biv[k]), int(bjv[k]))
+                  for k in np.nonzero(st == 1)[0]]
+        groups = self._compress_groups(lv, biv, bjv, st)
         if not refine and not groups:
             return False
 
         self._apply_regrid(refine, groups)
         return True
 
-    def _fix_states(self, state):
+    def _fix_states(self, lv, biv, bjv, st):
         """2:1 balance sweeps, finest level first (main.cpp:4734-4861):
         a block with a refining finer neighbor must refine; compressing
-        next to a finer or refining neighbor must stay. Runs the native
-        C kernel when available (cup2d_tpu/native — the reference's
-        equivalent bookkeeping is C++ inside adapt()); the Python body
-        below is the semantically identical fallback, asserted equal by
-        tests/test_native.py."""
+        next to a finer or refining neighbor must stay. ``st`` is
+        mutated in place. Runs the native C kernel when available
+        (cup2d_tpu/native — the reference's equivalent bookkeeping is
+        C++ inside adapt()); the Python body below is the semantically
+        identical fallback, asserted equal by tests/test_native.py."""
         cfg = self.cfg
-        if not native.available():   # skip dead marshalling on no-cc hosts
-            return self._fix_states_py(state)
-        keys = list(state.keys())
-        n = len(keys)
-        lvl = np.fromiter((k[0] for k in keys), np.int32, n)
-        bi = np.fromiter((k[1] for k in keys), np.int32, n)
-        bj = np.fromiter((k[2] for k in keys), np.int32, n)
-        st = np.fromiter((state[k] for k in keys), np.int8, n)
-        if native.fix_states(lvl, bi, bj, st, cfg.level_max,
-                             cfg.bpdx, cfg.bpdy):
-            for k, v in zip(keys, st.tolist()):
-                state[k] = v
+        # the native wrapper does its own contiguous-int32 conversion
+        if native.available() and native.fix_states(
+                lv, biv, bjv, st, cfg.level_max, cfg.bpdx, cfg.bpdy):
             return
+        state = {(int(lv[k]), int(biv[k]), int(bjv[k])): int(st[k])
+                 for k in range(len(st))}
         self._fix_states_py(state)
+        for k in range(len(st)):
+            st[k] = state[(int(lv[k]), int(biv[k]), int(bjv[k]))]
 
     def _fix_states_py(self, state):
         f = self.forest
@@ -1010,24 +1007,24 @@ class AMRSim(ShapeHostMixin):
                     if state[key] == 0:
                         break
 
-    def _compress_groups(self, state):
+    def _compress_groups(self, lv, biv, bjv, st):
         """Sibling groups where all 4 children exist and want compression
-        (main.cpp:4826-4861)."""
-        f = self.forest
-        seen = set()
+        (main.cpp:4826-4861). Vectorized: each compressing block hashes
+        to its parent key; a parent with FOUR compressing children is a
+        group (each (l, i, j) occurs once, and a compressing block is by
+        definition active, so count == 4 implies the quad exists)."""
+        cand = np.nonzero(st == -1)[0]
+        if len(cand) == 0:
+            return []
+        # row-unique instead of bit-packing: no coordinate-width limit
+        parents = np.stack(
+            [lv[cand], biv[cand] >> 1, bjv[cand] >> 1], axis=1)
+        uniq, counts = np.unique(parents, axis=0, return_counts=True)
         groups = []
-        for key, v in state.items():
-            if v != -1:
-                continue
-            l, i, j = key
-            base = (l, 2 * (i // 2), 2 * (j // 2))
-            if base in seen:
-                continue
-            seen.add(base)
-            sibs = [(l, base[1] + a, base[2] + b)
-                    for a in (0, 1) for b in (0, 1)]
-            if all(s in f.blocks and state.get(s, 0) == -1 for s in sibs):
-                groups.append(sibs)
+        for l, pi, pj in uniq[counts == 4]:
+            i0, j0 = 2 * int(pi), 2 * int(pj)
+            groups.append([(int(l), i0 + a, j0 + b)
+                           for a in (0, 1) for b in (0, 1)])
         return groups
 
     def _apply_regrid(self, refine_keys, groups):
