@@ -705,36 +705,110 @@ class AMRSim(ShapeHostMixin):
 
     def _window_blocks_estimate(self, s) -> int:
         """Finest-level blocks covering shape ``s``'s rasterization
-        window (the chi-tag region that ends up at level_max-1)."""
+        window (sizes the static raster window capacity)."""
         cfg = self.cfg
         h_fin = cfg.h_at(cfg.level_max - 1)
         r = 0.625 * s.length + 12.0 * cfg.min_h
         return int(np.ceil(2.0 * r / (cfg.bs * h_fin))) ** 2
 
-    def _estimate_blocks(self) -> int:
-        """Upper-ish estimate of the active block count the init climb
-        will reach: the full levelStart grid (the climb's starting point
-        and usual peak) plus, per shape, twice the finest-level blocks
-        covering its rasterization window (the factor 2 absorbing the
-        coarser-level pyramid and the 2:1 halo rings)."""
+    def _body_blocks_estimate(self, s) -> int:
+        """Finest-level blocks the chi-tag region around shape ``s``
+        actually occupies: the axis-aligned bbox of its surface polygon
+        (orientation included — the caller runs advect/midline first)
+        padded by the tag's 4-cell ghost window."""
         cfg = self.cfg
-        est = cfg.bpdx * cfg.bpdy << (2 * cfg.level_start)
+        bh = cfg.bs * cfg.h_at(cfg.level_max - 1)
+        pad = 8.0 * cfg.min_h
+        poly = s.surface_polygon()
+        ext = poly.max(axis=0) - poly.min(axis=0)
+        lb = int(np.ceil((float(ext[0]) + pad) / bh)) + 1
+        wb = int(np.ceil((float(ext[1]) + pad) / bh)) + 1
+        return lb * wb
+
+    def _estimate_blocks(self, coarse_start: bool) -> int:
+        """Upper-ish estimate of the peak active block count of the init
+        climb. Climbing UP from the coarsest grid (coarse_start), the
+        peak is near the final adapted count: background + per-shape
+        body blocks with a 2.5x margin for the coarser-level pyramid and
+        the 2:1 rings. Climbing DOWN from levelStart, the starting
+        uniform grid itself is the peak."""
+        cfg = self.cfg
+        est = cfg.bpdx * cfg.bpdy
+        if not coarse_start:
+            est += cfg.bpdx * cfg.bpdy << (2 * cfg.level_start)
         for s in self.shapes:
-            est += 2 * self._window_blocks_estimate(s)
+            est += int(2.5 * self._body_blocks_estimate(s))
         return est
+
+    def _refine_toward_shapes(self) -> bool:
+        """Bootstrap refinement for the init climb: refine every block
+        whose footprint, padded by the chi tag's 4-cell ghost window,
+        intersects a shape's bounding box and sits below level_max-1.
+        Host-geometric — equivalent to GradChiOnTmp tagging once chi is
+        resolvable, but works from grids so coarse the body is thinner
+        than one cell (where chi rasterizes to nothing). The normal
+        chi-driven adapt() immediately after the climb compresses the
+        few bbox-corner blocks the tighter chi window wouldn't keep."""
+        f = self.forest
+        cfg = self.cfg
+        self._refresh()
+        order = self._order
+        lv = f.level[order].astype(np.int64)
+        biv = f.bi[order].astype(np.int64)
+        bjv = f.bj[order].astype(np.int64)
+        h = cfg.h0 / (1 << lv).astype(np.float64)
+        bs = cfg.bs
+        pad = 4.0 * h * 2.0   # 4 ghost cells, one level finer margin
+        x0 = biv * bs * h - pad
+        x1 = (biv + 1) * bs * h + pad
+        y0 = bjv * bs * h - pad
+        y1 = (bjv + 1) * bs * h + pad
+        hit = np.zeros(len(order), bool)
+        for s in self.shapes:
+            poly = s.surface_polygon()
+            bx0, by0 = poly.min(axis=0)
+            bx1, by1 = poly.max(axis=0)
+            hit |= (x1 > bx0) & (x0 < bx1) & (y1 > by0) & (y0 < by1)
+        st = np.where(hit & (lv < cfg.level_max - 1), 1, 0).astype(np.int8)
+        if not st.any():
+            return False
+        self._fix_states(lv, biv, bjv, st)
+        refine = [(int(lv[k]), int(biv[k]), int(bjv[k]))
+                  for k in np.nonzero(st == 1)[0]]
+        self._apply_regrid(refine, [])
+        return True
 
     def initialize(self):
         """The reference's startup (main.cpp:6542-6575): levelMax rounds
         of {rasterize; adapt} refine the grid around the bodies, then
         the initial velocity is the chi-blended deformation velocity.
-        The padded block axis is pre-sized to the estimated final count
-        so the climb compiles one executable set instead of one per
-        bucket crossing (BASELINE.md round-2 notes)."""
+
+        Two compile/throughput measures (BASELINE.md round-2 notes):
+        the padded block axis and raster windows are pre-sized from
+        block estimates so the climb compiles one executable set; and
+        when the fields are still identically zero, the climb starts
+        from the COARSEST grid and refines up toward the bodies
+        (host-geometric bootstrap tags) instead of starting from the
+        full levelStart grid and compressing the background away — the
+        tag rules have the same fixed point (zero fields carry no
+        vorticity), but the peak block count is the final adapted count
+        rather than 4^levelStart x base, so the pad bucket the whole
+        run inherits is several powers of two smaller."""
         if not self.shapes:
             self._initialized = True
             return
         cfg = self.cfg
-        self.reserve_blocks(self._estimate_blocks())
+        f = self.forest
+        for s in self.shapes:
+            s.advect(0.0, cfg.extents)
+            s.midline(0.0)
+        allzero = not any(
+            bool(jnp.any(v != 0)) for v in f.fields.values())
+        # ctol <= 0 disables compression: the from-above climb then
+        # keeps the levelStart background forever, so coarse start would
+        # genuinely change the grid, not just its construction order
+        coarse = allzero and cfg.level_start > 0 and cfg.ctol > 0
+        self.reserve_blocks(self._estimate_blocks(coarse))
         # pre-size the per-shape rasterization windows the same way:
         # every window-capacity crossing during the climb recompiles the
         # megastep (the biggest executable in the repo)
@@ -742,9 +816,15 @@ class AMRSim(ShapeHostMixin):
             want = int(2.6 * self._window_blocks_estimate(s)) + 16
             self._wcap[k] = max(
                 self._wcap[k], 1 << max(0, (want - 1)).bit_length())
-        for s in self.shapes:
-            s.advect(0.0, cfg.extents)
-            s.midline(0.0)
+        if coarse:
+            for key in list(f.blocks):
+                f.release(*key)
+            for j in range(cfg.bpdy):
+                for i in range(cfg.bpdx):
+                    f.allocate(0, i, j)
+            for _ in range(cfg.level_max + 2):
+                if not self._refine_toward_shapes():
+                    break
         for _ in range(cfg.level_max):
             obs = self._rasterize()
             self._write_chi(obs)
