@@ -669,7 +669,7 @@ class AMRSim(ShapeHostMixin):
         dt_ = f.dtype
         out = []
         for k, s in enumerate(self.shapes):
-            r = 0.625 * s.length + 12.0 * cfg.min_h
+            r = self._raster_radius(s)
             cx, cy = s.com
             hit = (x1 > cx - r) & (x0 < cx + r) \
                 & (y1 > cy - r) & (y0 < cy + r)
@@ -703,12 +703,25 @@ class AMRSim(ShapeHostMixin):
         f.fields["chi"] = f.fields["chi"].at[self._order_j].set(
             obs.chi[:, None])
 
+    def _raster_radius(self, s) -> float:
+        """Half-extent of a shape's rasterization window (the
+        AreaSegment AABB padding policy, main.cpp:4237) — the ONE
+        definition shared by window selection and capacity sizing."""
+        return 0.625 * s.length + 12.0 * self.cfg.min_h
+
+    @staticmethod
+    def _shape_bbox(s):
+        """Axis-aligned bbox of the shape's surface polygon (valid after
+        advect/midline)."""
+        poly = s.surface_polygon()
+        return poly.min(axis=0), poly.max(axis=0)
+
     def _window_blocks_estimate(self, s) -> int:
         """Finest-level blocks covering shape ``s``'s rasterization
         window (sizes the static raster window capacity)."""
         cfg = self.cfg
         h_fin = cfg.h_at(cfg.level_max - 1)
-        r = 0.625 * s.length + 12.0 * cfg.min_h
+        r = self._raster_radius(s)
         return int(np.ceil(2.0 * r / (cfg.bs * h_fin))) ** 2
 
     def _body_blocks_estimate(self, s) -> int:
@@ -719,10 +732,9 @@ class AMRSim(ShapeHostMixin):
         cfg = self.cfg
         bh = cfg.bs * cfg.h_at(cfg.level_max - 1)
         pad = 8.0 * cfg.min_h
-        poly = s.surface_polygon()
-        ext = poly.max(axis=0) - poly.min(axis=0)
-        lb = int(np.ceil((float(ext[0]) + pad) / bh)) + 1
-        wb = int(np.ceil((float(ext[1]) + pad) / bh)) + 1
+        lo, hi = self._shape_bbox(s)
+        lb = int(np.ceil((float(hi[0] - lo[0]) + pad) / bh)) + 1
+        wb = int(np.ceil((float(hi[1] - lo[1]) + pad) / bh)) + 1
         return lb * wb
 
     def _estimate_blocks(self, coarse_start: bool) -> int:
@@ -758,25 +770,17 @@ class AMRSim(ShapeHostMixin):
         bjv = f.bj[order].astype(np.int64)
         h = cfg.h0 / (1 << lv).astype(np.float64)
         bs = cfg.bs
-        pad = 4.0 * h * 2.0   # 4 ghost cells, one level finer margin
+        pad = 8.0 * h   # 4 ghost cells x one-level-finer margin
         x0 = biv * bs * h - pad
         x1 = (biv + 1) * bs * h + pad
         y0 = bjv * bs * h - pad
         y1 = (bjv + 1) * bs * h + pad
         hit = np.zeros(len(order), bool)
         for s in self.shapes:
-            poly = s.surface_polygon()
-            bx0, by0 = poly.min(axis=0)
-            bx1, by1 = poly.max(axis=0)
+            (bx0, by0), (bx1, by1) = self._shape_bbox(s)
             hit |= (x1 > bx0) & (x0 < bx1) & (y1 > by0) & (y0 < by1)
         st = np.where(hit & (lv < cfg.level_max - 1), 1, 0).astype(np.int8)
-        if not st.any():
-            return False
-        self._fix_states(lv, biv, bjv, st)
-        refine = [(int(lv[k]), int(biv[k]), int(bjv[k]))
-                  for k in np.nonzero(st == 1)[0]]
-        self._apply_regrid(refine, [])
-        return True
+        return self._commit_states(lv, biv, bjv, st)
 
     def initialize(self):
         """The reference's startup (main.cpp:6542-6575): levelMax rounds
@@ -802,8 +806,10 @@ class AMRSim(ShapeHostMixin):
         for s in self.shapes:
             s.advect(0.0, cfg.extents)
             s.midline(0.0)
-        allzero = not any(
-            bool(jnp.any(v != 0)) for v in f.fields.values())
+        # one fused device query + one pull (a per-field pull costs a
+        # tunnel round trip each)
+        allzero = not bool(jnp.any(jnp.stack(
+            [jnp.any(v != 0) for v in f.fields.values()])))
         # ctol <= 0 disables compression: the from-above climb then
         # keeps the levelStart background forever, so coarse start would
         # genuinely change the grid, not just its construction order
@@ -814,8 +820,7 @@ class AMRSim(ShapeHostMixin):
         # megastep (the biggest executable in the repo)
         for k, s in enumerate(self.shapes):
             want = int(2.6 * self._window_blocks_estimate(s)) + 16
-            self._wcap[k] = max(
-                self._wcap[k], 1 << max(0, (want - 1)).bit_length())
+            self._wcap[k] = max(self._wcap[k], _bucket(want, lo=16))
         if coarse:
             for key in list(f.blocks):
                 f.release(*key)
@@ -1007,17 +1012,21 @@ class AMRSim(ShapeHostMixin):
             (tags > cfg.rtol) & (lv < cfg.level_max - 1), 1,
             np.where((tags < cfg.ctol) & (lv > 0), -1, 0)
         ).astype(np.int8)
+        return self._commit_states(lv, biv, bjv, st)
+
+    def _commit_states(self, lv, biv, bjv, st) -> bool:
+        """Shared tail of every regrid decision (chi/vorticity adapts
+        AND the init-climb bootstrap): 2:1 state fixing, refine/compress
+        extraction, one fused regrid dispatch. Returns whether anything
+        changed."""
         if not st.any():
             return False
-
         self._fix_states(lv, biv, bjv, st)
-
         refine = [(int(lv[k]), int(biv[k]), int(bjv[k]))
                   for k in np.nonzero(st == 1)[0]]
         groups = self._compress_groups(lv, biv, bjv, st)
         if not refine and not groups:
             return False
-
         self._apply_regrid(refine, groups)
         return True
 
