@@ -71,6 +71,7 @@ def main(argv=None) -> int:
         if uniform:
             dump_uniform(path, sim.time, sim.state.vel, sim.grid.h)
         else:
+            sim.sync_fields()
             dump_forest(path, sim.time, sim.forest)
 
     next_dump = sim.time if cfg.dump_time > 0 else float("inf")
@@ -95,6 +96,8 @@ def main(argv=None) -> int:
             save_checkpoint(os.path.join(outdir, "checkpoint"), sim)
 
     sim.force_log.close()
+    if not uniform:
+        sim.sync_fields()   # leave the slot fields dict current
     if sim.timers is not None:
         from .profiling import throughput
         print(sim.timers.summary(), file=sys.stderr)

@@ -83,6 +83,26 @@ class ObstacleForestFields(NamedTuple):
     inertia: jnp.ndarray  # [S]
 
 
+def _window_sdf_udef(inp, bs: int, dtype):
+    """Evaluate one shape's SDF + deformation velocity over its window
+    blocks ([P, BS, BS] / [2, P, BS, BS]) from the window-block origins
+    shipped in ``inp`` (PutFishOnBlocks, main.cpp:3774-3990). The ONE
+    definition shared by the single-device scatter and the shard-local
+    scatter (forest_mesh.ShardedAMRSim._window_raster) — the sharded ==
+    single-device equality tests assume bit-identical evaluation."""
+    ar = jnp.arange(bs, dtype=dtype) + 0.5
+    wh = inp["wh"][:, None, None]
+    xw = inp["wx0"][:, None, None] + ar[None, None, :] * wh
+    yw = inp["wy0"][:, None, None] + ar[None, :, None] * wh
+    com = inp["com"]
+    d = polygon_sdf(xw - com[0], yw - com[1], inp["poly"] - com)
+    ud = midline_udef(
+        xw - com[0], yw - com[1], inp["mid_r"] - com,
+        inp["mid_v"], inp["mid_nor"], inp["mid_vnor"],
+        inp["width"])
+    return d, ud
+
+
 class AMRSim(ShapeHostMixin):
     """Adaptive flow solver on the block forest, with or without
     immersed obstacles (the reference's only mode is 'with')."""
@@ -113,6 +133,14 @@ class AMRSim(ShapeHostMixin):
         self._tables_version = -1
         self._tables = {}
         self._order = None
+        # SFC-ordered compact working state ([n_pad, dim, BS, BS] per
+        # field) — the device-resident truth between regrids. The
+        # slot-layout fields dict is synced lazily (sync_fields); _ord_key
+        # tracks (topology version, fields write-version) so external
+        # slot writes invalidate the cache (forest._FieldsDict.wver)
+        self._ord = None
+        self._ord_key = None
+        self._ord_dirty = False
         self._wcap = [16] * len(self.shapes)
         # sticky block-axis padding (see _refresh_impl)
         self._npad_hwm = 128
@@ -204,42 +232,37 @@ class AMRSim(ShapeHostMixin):
         self._n_real = n_real
         self._mask = np.arange(n_pad) < n_real
 
-        def padded(t):
-            return pad_tables(t, n_pad)
-
         tm = self.timers or NULL_TIMERS
         # one dense topology index shared by all 6-8 table builds
         topo = _TopoIndex(f, self._order)
         with tm.phase("tables/build"):
-            self._tables = {
-                "vec3": padded(build_tables(f, self._order, 3, True, 2,
-                                            topo=topo)),
-                "vec1": padded(build_tables(f, self._order, 1, False, 2,
-                                            topo=topo)),
-                "sca1": padded(build_tables(f, self._order, 1, False, 1,
-                                            topo=topo)),
-                "vec1t": padded(build_tables(f, self._order, 1, True, 2,
-                                             topo=topo)),
-                "sca1t": padded(build_tables(f, self._order, 1, True, 1,
-                                             topo=topo)),
+            raw = {
+                "vec3": build_tables(f, self._order, 3, True, 2,
+                                     topo=topo),
+                "vec1": build_tables(f, self._order, 1, False, 2,
+                                     topo=topo),
+                "sca1": build_tables(f, self._order, 1, False, 1,
+                                     topo=topo),
+                "vec1t": build_tables(f, self._order, 1, True, 2,
+                                      topo=topo),
+                "sca1t": build_tables(f, self._order, 1, True, 1,
+                                      topo=topo),
                 # makeFlux variable-resolution Poisson rows (flux.py)
-                "pois": padded(
-                    build_poisson_tables(f, self._order, topo=topo)),
+                "pois": build_poisson_tables(f, self._order, topo=topo),
             }
             if self.shapes:
                 # chi tagging (g=4 scalar) + forces (g=4 vector)
-                self._tables["sca4t"] = padded(
-                    build_tables(f, self._order, 4, True, 1, topo=topo))
-                self._tables["vec4t"] = padded(
-                    build_tables(f, self._order, 4, True, 2, topo=topo))
+                raw["sca4t"] = build_tables(f, self._order, 4, True, 1,
+                                            topo=topo)
+                raw["vec4t"] = build_tables(f, self._order, 4, True, 2,
+                                            topo=topo)
         # one async transfer for every table leaf (pad_tables returns
         # numpy on purpose; per-leaf jnp.asarray would synchronize per
         # array — ~14 s/regrid through the TPU tunnel, measured)
         with tm.phase("tables/put"):
-            self._tables = jax.device_put(self._tables)
+            self._tables = self._finalize_tables(raw, n_pad)
         with tm.phase("tables/corr"):
-            self._corr = build_flux_corr(f, self._order, n_pad=n_pad,
-                                         topo=topo)
+            self._corr = self._finalize_corr(topo, n_pad)
         h = f.h_per_block(self._order)
         hp = np.concatenate([h, np.ones(n_pad - n_real)])
         hsqp = np.concatenate([h * h, np.zeros(n_pad - n_real)])
@@ -262,41 +285,103 @@ class AMRSim(ShapeHostMixin):
         self._yc = jnp.asarray(yc, f.dtype)
         self._tables_version = f.version
 
+    # table placement hooks (ShardedAMRSim splits the hot-loop sets
+    # into per-device rows + a surface-exchange plan)
+    def _finalize_tables(self, raw: dict, n_pad: int) -> dict:
+        return jax.device_put(
+            {k: pad_tables(t, n_pad) for k, t in raw.items()})
+
+    def _finalize_corr(self, topo, n_pad: int):
+        return build_flux_corr(self.forest, self._order, n_pad=n_pad,
+                               topo=topo)
+
+    # ------------------------------------------------------------------
+    # ordered working state
+    # ------------------------------------------------------------------
+    # The reference's hot loop reads/writes blocks through per-rank
+    # `infos` vectors kept in SFC order (main.cpp:1550-1562); the slot
+    # map is bookkeeping. Same inversion here: between regrids the
+    # device state IS the ordered compact array set, so no step pays a
+    # slot<->ordered permutation (under a device mesh that permutation
+    # is a volume-sized collective; the ordered arrays are sharded in
+    # contiguous SFC ranges exactly like the reference's rank ranges).
+    def _ordered_state(self) -> dict:
+        f = self.forest
+        self._refresh()
+        key = (f.version, f.fields.wver)
+        if self._ord_key == key:
+            return self._ord
+        if self._ord_dirty:
+            # a hard error (not an assert: must survive python -O) —
+            # rebuilding from the stale slot arrays here would silently
+            # discard the last completed step's fields
+            raise RuntimeError(
+                "slot fields were written while the ordered working "
+                "state held newer data; call sync_fields() before "
+                "writing forest.fields")
+        self._ord = {name: self._put_ordered(fld[self._order_j])
+                     for name, fld in f.fields.items()}
+        self._ord_key = key
+        return self._ord
+
+    def _put_ordered(self, x):
+        """Placement hook: ShardedAMRSim pins the ordered block axis to
+        the device mesh here."""
+        return x
+
+    def sync_fields(self):
+        """Write the ordered working state back into the slot-layout
+        fields dict (regrid prolongation, dumps, checkpoints and tests
+        read slots). No-op when already in sync."""
+        if not self._ord_dirty:
+            return
+        f = self.forest
+        order = jnp.asarray(self._order)
+        for name, x in self._ord.items():
+            f.fields[name] = f.fields[name].at[order].set(
+                x[:self._n_real])
+        self._ord_key = (f.version, f.fields.wver)
+        self._ord_dirty = False
+
+    def _set_ordered(self, **updates):
+        """Adopt step outputs as the new ordered truth."""
+        self._ord = {**self._ord, **updates}
+        self._ord_dirty = True
+
     # ------------------------------------------------------------------
     # shared device stages
     # ------------------------------------------------------------------
-    def _advect_rk2(self, vel, order, h, dt, t3, corr, maskv):
+    def _advect_rk2(self, vel, h, dt, t3, corr, maskv):
         """Heun RK2 advection-diffusion (per-block h); diffusive face
         fluxes flux-corrected at level interfaces (fillcases after each
-        stage, main.cpp:6607-6642). Returns updated ordered blocks.
-        ``maskv`` zeroes the padded rows each stage (pad-slot data is
-        stale, never NaN — see _refresh)."""
+        stage, main.cpp:6607-6642). ``vel`` and the result are ordered
+        compact [N,2,BS,BS]. ``maskv`` zeroes the padded rows each stage
+        (pad-row data is stale, never NaN — see _refresh)."""
         cfg = self.cfg
         ih2 = 1.0 / (h * h)
-        vold = vel[order] * maskv        # [N,2,BS,BS]
+        vold = vel * maskv               # [N,2,BS,BS]
         v = vold
         for c in (0.5, 1.0):
-            lab = assemble_labs(
-                vel.at[order].set(v) if c == 1.0 else vel, order, t3)
+            lab = assemble_labs_ordered(v if c == 1.0 else vel, t3)
             rhs = advect_diffuse_rhs(lab, 3, h, cfg.nu, dt)
             rhs = apply_flux_corr(
                 rhs, diffusive_deposits(lab, 3, cfg.nu * dt), corr)
             v = (vold + c * rhs * ih2) * maskv
         return v
 
-    def _pressure_project(self, vel, v, pres, dt, order, h, hsq,
+    def _pressure_project(self, v, pres, dt, h, hsq,
                           t1v, t1s, tpois, corr, exact_poisson, maskv,
                           chi=None, udef_b=None):
         """deltap Poisson solve + projection (main.cpp:7007-7187). The
         RHS divergence is flux-corrected; the operator (also applied to
         the initial guess p_old) is the makeFlux variable-resolution
         closure — conservative on both sides of every interface.
-        ``chi``/``udef_b`` add the -chi div(u_def) obstacle term."""
+        ``chi``/``udef_b`` add the -chi div(u_def) obstacle term.
+        All operands ordered compact; returns (v_new, p_new, res)."""
         cfg = self.cfg
         ih2 = 1.0 / (h * h)
-        pord = pres[order][:, 0] * maskv[:, 0]   # [N,BS,BS]
-        vel_full = vel.at[order].set(v)
-        vlab = assemble_labs(vel_full, order, t1v)
+        pord = pres[:, 0] * maskv[:, 0]          # [N,BS,BS]
+        vlab = assemble_labs_ordered(v, t1v)
         fac = 0.5 * h[:, 0] / dt
         b = fac * divergence(vlab, 1)
         ulab = None
@@ -347,36 +432,33 @@ class AMRSim(ShapeHostMixin):
         dv = apply_flux_corr(
             dv, gradient_deposits(plab[:, 0], pfac), corr)
         v = (v + dv * ih2) * maskv
-
-        vel_out = vel_full.at[order].set(v)
-        pres_out = pres.at[order].set(p_new[:, None])
-        return vel_out, pres_out, res, v
+        return v, p_new[:, None], res
 
     # ------------------------------------------------------------------
     # device step: obstacle-free (the oracle path)
     # ------------------------------------------------------------------
-    def _step_impl(self, vel, pres, dt, order, h, hsq, maskv,
+    def _step_impl(self, vel, pres, dt, h, hsq, maskv,
                    t3, t1v, t1s, tpois, corr, exact_poisson=False):
-        v = self._advect_rk2(vel, order, h, dt, t3, corr, maskv)
-        vel, pres, res, v = self._pressure_project(
-            vel, v, pres, dt, order, h, hsq, t1v, t1s, tpois, corr,
+        v = self._advect_rk2(vel, h, dt, t3, corr, maskv)
+        v, p_new, res = self._pressure_project(
+            v, pres, dt, h, hsq, t1v, t1s, tpois, corr,
             exact_poisson, maskv)
         diag = {
             "poisson_iters": res.iters,
             "poisson_residual": res.residual,
             "umax": jnp.max(jnp.abs(v)),
         }
-        return vel, pres, diag
+        return v, p_new, diag
 
     # ------------------------------------------------------------------
     # device step: with obstacles (the reference hot loop 6607-7187)
     # ------------------------------------------------------------------
-    def _flow_impl(self, vel, pres, obs, prescribed, dt, order, h, hsq,
+    def _flow_impl(self, vel, pres, obs, prescribed, dt, h, hsq,
                    maskv, xc, yc, t3, t1v, t1s, tpois, corr,
                    exact_poisson=False):
         cfg = self.cfg
         S = len(self.shapes)
-        v = self._advect_rk2(vel, order, h, dt, t3, corr, maskv)
+        v = self._advect_rk2(vel, h, dt, t3, corr, maskv)
         v_cf = v.transpose(1, 0, 2, 3)   # component-first [2,N,BS,BS]
 
         # rigid momentum solve per shape (main.cpp:6643-6704)
@@ -424,8 +506,8 @@ class AMRSim(ShapeHostMixin):
         v = v_cf.transpose(1, 0, 2, 3)
 
         udef = self._combined_udef(obs)  # [2,N,BS,BS]
-        vel, pres, res, v = self._pressure_project(
-            vel, v, pres, dt, order, h, hsq, t1v, t1s, tpois, corr,
+        v, p_new, res = self._pressure_project(
+            v, pres, dt, h, hsq, t1v, t1s, tpois, corr,
             exact_poisson, maskv,
             chi=obs.chi, udef_b=udef.transpose(1, 0, 2, 3))
         diag = {
@@ -433,7 +515,7 @@ class AMRSim(ShapeHostMixin):
             "poisson_residual": res.residual,
             "umax": jnp.max(jnp.abs(v)),
         }
-        return vel, pres, uvw, diag
+        return v, p_new, uvw, diag
 
     # ------------------------------------------------------------------
     # device: the fused per-step megacall — rasterize + flow (+ forces)
@@ -441,15 +523,14 @@ class AMRSim(ShapeHostMixin):
     # and one batched device->host pull (each round trip is ~100 ms
     # through the TPU tunnel; the unfused chain paid ~6 of them)
     # ------------------------------------------------------------------
-    def _megastep_impl(self, vel, pres, chi_field, inputs, prescribed,
-                       dt, hmin, order, h, hsq, maskv, xc, yc,
+    def _megastep_impl(self, vel, pres, inputs, prescribed,
+                       dt, hmin, h, hsq, maskv, xc, yc,
                        t3, t1v, t1s, tpois, t4v, t4s, corr,
                        exact_poisson=False, with_forces=False):
         cfg = self.cfg
         obs = self._rasterize_impl(inputs, xc, yc, h[:, 0], hsq, t1s)
-        chi_new = chi_field.at[order].set(obs.chi[:, None])
         vel, pres, uvw, diag = self._flow_impl(
-            vel, pres, obs, prescribed, dt, order, h, hsq, maskv,
+            vel, pres, obs, prescribed, dt, h, hsq, maskv,
             xc, yc, t3, t1v, t1s, tpois, corr,
             exact_poisson=exact_poisson)
         # next step's dt from THIS step's end-state umax, same shared
@@ -458,10 +539,10 @@ class AMRSim(ShapeHostMixin):
         forces = None
         if with_forces:
             forces = self._forces_impl(
-                vel, pres, obs, uvw, order, t4v, t4s,
+                vel, pres, obs, uvw, t4v, t4s,
                 h[:, 0, 0, 0], xc, yc)
         scalars = (uvw, obs.com, obs.mass, obs.inertia, dt_next, diag)
-        return vel, pres, chi_new, scalars, forces
+        return vel, pres, obs.chi[:, None], scalars, forces
 
     @staticmethod
     def _combined_udef(obs: ObstacleForestFields) -> jnp.ndarray:
@@ -488,28 +569,10 @@ class AMRSim(ShapeHostMixin):
         per = []
         for k in range(S):
             inp = inputs[k]
-            pos = inp["pos"]                 # [P], -1 = padding
-            gpos = jnp.maximum(pos, 0)
-            wmask = pos >= 0
-            xw = xc[gpos]
-            yw = yc[gpos]
-            com = inp["com"]
-            poly = inp["poly"] - com
-            d = polygon_sdf(xw - com[0], yw - com[1], poly)
-            ud = midline_udef(
-                xw - com[0], yw - com[1], inp["mid_r"] - com,
-                inp["mid_v"], inp["mid_nor"], inp["mid_vnor"],
-                inp["width"])                # [2,P,BS,BS]
-            spos = jnp.where(wmask, pos, N)
-            wm3 = wmask[:, None, None]
-            sdf_k = jnp.full((N + 1, bs, bs), neg, dtype).at[spos].set(
-                jnp.where(wm3, d, neg))[:N]
-            udef_k = jnp.zeros((2, N + 1, bs, bs), dtype).at[:, spos].set(
-                jnp.where(wm3[None], ud, 0.0))[:, :N]
-            wm_k = jnp.zeros((N + 1,), dtype).at[spos].set(
-                wmask.astype(dtype))[:N]
+            sdf_k, udef_k, wm_k = self._window_raster(
+                inp, xc, yc, neg, N)
             sdf = jnp.maximum(sdf, sdf_k)
-            per.append((sdf_k, udef_k, wm_k, com))
+            per.append((sdf_k, udef_k, wm_k, inp["com"]))
 
         # chi from the COMBINED sdf lab at each block's own h
         # (PutChiOnGrid, main.cpp:3911-3969)
@@ -554,33 +617,55 @@ class AMRSim(ShapeHostMixin):
             inertia=jnp.stack(inertias),
         )
 
+    def _window_raster(self, inp, xc, yc, neg, N):
+        """SDF + deformation velocity of one shape over its window
+        blocks, scattered into the ordered block layout (the PutFish-
+        OnBlocks gather form, main.cpp:3774-3990). ShardedAMRSim
+        overrides the SCATTER with a per-device split (shard-local
+        writes); the evaluation itself is the shared _window_sdf_udef,
+        so the two paths cannot drift apart numerically."""
+        bs = self.cfg.bs
+        dtype = self.forest.dtype
+        pos = inp["pos"]                 # [P], -1 = padding
+        wmask = pos >= 0
+        d, ud = _window_sdf_udef(inp, bs, dtype)
+        spos = jnp.where(wmask, pos, N)
+        wm3 = wmask[:, None, None]
+        sdf_k = jnp.full((N + 1, bs, bs), neg, dtype).at[spos].set(
+            jnp.where(wm3, d, neg))[:N]
+        udef_k = jnp.zeros((2, N + 1, bs, bs), dtype).at[:, spos].set(
+            jnp.where(wm3[None], ud, 0.0))[:, :N]
+        wm_k = jnp.zeros((N + 1,), dtype).at[spos].set(
+            wmask.astype(dtype))[:N]
+        return sdf_k, udef_k, wm_k
+
     # ------------------------------------------------------------------
     # device: tagging kernels
     # ------------------------------------------------------------------
-    def _vorticity_impl(self, vel, order, h, t1v):
+    def _vorticity_impl(self, vel, h, t1v):
         """Per-block Linf of vorticity (the refinement tag,
-        main.cpp:4671-4688)."""
-        lab = assemble_labs(vel, order, t1v)
+        main.cpp:4671-4688). ``vel`` ordered compact."""
+        lab = assemble_labs_ordered(vel, t1v)
         w = vorticity(lab, 1, h[:, 0])             # [N, BS, BS]
         return jnp.max(jnp.abs(w), axis=(-1, -2))  # [N]
 
-    def _chi_tag_impl(self, chi_field, order, t4s, finest):
+    def _chi_tag_impl(self, chi_o, t4s, finest):
         """GradChiOnTmp (main.cpp:4631-4656): any positive chi in the
         block's padded window forces refinement (offset 4 at the finest
         level — where it only blocks compression — else 2)."""
-        lab = assemble_labs(chi_field, order, t4s)[:, 0]   # [N, L, L]
+        lab = assemble_labs_ordered(chi_o, t4s)[:, 0]      # [N, L, L]
         c = jnp.clip(lab, 0.0, 1.0)
         has4 = jnp.max(c, axis=(-1, -2)) > 0.0
         has2 = jnp.max(c[:, 2:-2, 2:-2], axis=(-1, -2)) > 0.0
         return jnp.where(finest, has4, has2)
 
-    def _tags_impl(self, vel, chi_field, order, h, t1v, t4s, finest):
+    def _tags_impl(self, vel, chi_o, h, t1v, t4s, finest):
         """Fused refinement tags: max of the vorticity Linf and the
         GradChiOnTmp marker (2*Rtol where chi is present) — the two
         computeA passes the reference runs back to back (adapt(),
         main.cpp:4659-4661), one dispatch here."""
-        w = self._vorticity_impl(vel, order, h, t1v)
-        has = self._chi_tag_impl(chi_field, order, t4s, finest)
+        w = self._vorticity_impl(vel, h, t1v)
+        has = self._chi_tag_impl(chi_o, t4s, finest)
         return jnp.maximum(w, jnp.where(has, 2.0 * self.cfg.rtol, 0.0))
 
     def _prolong_impl(self, field, parents, order, t):
@@ -634,12 +719,12 @@ class AMRSim(ShapeHostMixin):
     # ------------------------------------------------------------------
     # device: surface force diagnostics (main.cpp:7188-7284)
     # ------------------------------------------------------------------
-    def _forces_impl(self, vel, pres, obs, uvw, order, t4v, t4s,
+    def _forces_impl(self, vel, pres, obs, uvw, t4v, t4s,
                      hflat, xc, yc):
-        velp = assemble_labs(vel, order, t4v)                  # [N,2,L,L]
+        velp = assemble_labs_ordered(vel, t4v)                 # [N,2,L,L]
         chip = assemble_labs_ordered(obs.chi[:, None], t4s)[:, 0]
         sdfp = assemble_labs_ordered(obs.sdf[:, None], t4s)[:, 0]
-        pord = pres[order][:, 0]
+        pord = pres[:, 0]
         out = []
         for k in range(len(self.shapes)):
             out.append(surface_forces_blocks(
@@ -679,9 +764,21 @@ class AMRSim(ShapeHostMixin):
                     16, 1 << int(np.ceil(np.log2(len(idx) * 1.3))))
             pos = np.full(self._wcap[k], -1, np.int32)
             pos[:len(idx)] = idx
+            # window-block origins/spacings ride along so the raster
+            # kernel computes its cell coordinates instead of gathering
+            # them from the (possibly sharded) per-block arrays
+            wx0 = np.zeros(self._wcap[k])
+            wy0 = np.zeros(self._wcap[k])
+            wh = np.ones(self._wcap[k])
+            wx0[:len(idx)] = x0[idx]
+            wy0[:len(idx)] = y0[idx]
+            wh[:len(idx)] = h[idx]
             mid_r, mid_v, mid_nor, mid_vnor = s.midline_comp_frame()
             out.append({
                 "pos": jnp.asarray(pos),
+                "wx0": jnp.asarray(wx0, dtype=dt_),
+                "wy0": jnp.asarray(wy0, dtype=dt_),
+                "wh": jnp.asarray(wh, dtype=dt_),
                 "poly": jnp.asarray(s.surface_polygon(), dtype=dt_),
                 "mid_r": jnp.asarray(mid_r, dtype=dt_),
                 "mid_v": jnp.asarray(mid_v, dtype=dt_),
@@ -858,11 +955,10 @@ class AMRSim(ShapeHostMixin):
         return dt_from_umax(umax, hmin, self.cfg.nu, self.cfg.cfl)
 
     def compute_dt(self) -> float:
-        self._refresh()
         f = self.forest
-        # active slots only — freed slots keep stale data until reused
+        # masked: ordered pad rows carry stale (finite) data
         umax = jnp.max(jnp.abs(
-            f.fields["vel"][self._order_j]) * self._maskv)
+            self._ordered_state()["vel"]) * self._maskv)
         hmin = jnp.asarray(
             self.cfg.h_at(int(f.level[self._order].max())), f.dtype)
         return float(self._dt_from_umax(umax, hmin))
@@ -872,20 +968,20 @@ class AMRSim(ShapeHostMixin):
         f = self.forest
         if not self.shapes:
             tm = self.timers or NULL_TIMERS
+            ordf = self._ordered_state()
             if dt is None:
                 with tm.phase("dt"):
                     dt = self.compute_dt()
             exact = self.step_count < 10
             with tm.phase("flow"):
                 vel, pres, diag = self._step_jit(
-                    f.fields["vel"], f.fields["pres"],
+                    ordf["vel"], ordf["pres"],
                     jnp.asarray(dt, f.dtype),
-                    self._order_j, self._h, self._hsq_flat, self._maskv,
+                    self._h, self._hsq_flat, self._maskv,
                     self._tables["vec3"], self._tables["vec1"],
                     self._tables["sca1"], self._tables["pois"],
                     self._corr, exact_poisson=exact)
-                f.fields["vel"] = vel
-                f.fields["pres"] = pres
+                self._set_ordered(vel=vel, pres=pres)
                 if self.timers is not None:
                     jax.block_until_ready(vel)  # charge flow to "flow"
             self.time += dt
@@ -911,12 +1007,17 @@ class AMRSim(ShapeHostMixin):
                 # arithmetic — one scalar round trip instead of a full
                 # field reduction + compile after every adapt (9.5 s/call
                 # measured on the canonical case through the tunnel).
+                # The 1.05 factor turns the prolongation-overshoot
+                # argument from an asserted comment into an enforced
+                # bound (ADVICE r2): any overshoot up to 5% now tightens
+                # dt instead of silently stretching CFL.
                 with tm.phase("dt"):
                     hmin = jnp.asarray(
                         self.cfg.h_at(int(f.level[self._order].max())),
                         f.dtype)
                     dt = min(float(self._dt_from_umax(
-                        jnp.asarray(self._next_umax, f.dtype), hmin)),
+                        jnp.asarray(1.05 * self._next_umax, f.dtype),
+                        hmin)),
                         self._kinematic_dt_cap())
             else:
                 with tm.phase("dt"):
@@ -939,20 +1040,19 @@ class AMRSim(ShapeHostMixin):
             and self.step_count % self.compute_forces_every == 0)
         hmin = jnp.asarray(
             cfg.h_at(int(f.level[self._order].max())), f.dtype)
+        ordf = self._ordered_state()
         with tm.phase("flow"):
             vel, pres, chi_new, scalars, forces = self._mega_jit(
-                f.fields["vel"], f.fields["pres"], f.fields["chi"],
+                ordf["vel"], ordf["pres"],
                 inputs, prescribed, jnp.asarray(dt, f.dtype), hmin,
-                self._order_j, self._h, self._hsq_flat, self._maskv,
+                self._h, self._hsq_flat, self._maskv,
                 self._xc, self._yc,
                 self._tables["vec3"], self._tables["vec1"],
                 self._tables["sca1"], self._tables["pois"],
                 self._tables.get("vec4t"), self._tables.get("sca4t"),
                 self._corr, exact_poisson=exact,
                 with_forces=with_forces)
-            f.fields["vel"] = vel
-            f.fields["pres"] = pres
-            f.fields["chi"] = chi_new
+            self._set_ordered(vel=vel, pres=pres, chi=chi_new)
             # the ONE host pull of the step
             uvw, com, mass, inertia, dt_next, diag, forces = \
                 jax.device_get((*scalars, forces))
@@ -987,17 +1087,18 @@ class AMRSim(ShapeHostMixin):
         cfg = self.cfg
         # one fused dispatch + one pull for both tag kernels (each extra
         # sync costs a full tunnel round trip)
+        ordf = self._ordered_state()
         if self.shapes and "chi" in f.fields:
             finest = np.zeros(len(self._mask), bool)
             finest[:self._n_real] = \
                 f.level[self._order] == cfg.level_max - 1
             tags = np.asarray(self._tags_jit(
-                f.fields["vel"], f.fields["chi"], self._order_j,
+                ordf["vel"], ordf["chi"],
                 self._h, self._tables["vec1"], self._tables["sca4t"],
                 jnp.asarray(finest)))[:self._n_real]
         else:
             tags = np.asarray(self._vorticity_jit(
-                f.fields["vel"], self._order_j, self._h,
+                ordf["vel"], self._h,
                 self._tables["vec1"]))[:self._n_real]
         order = self._order
 
@@ -1129,6 +1230,10 @@ class AMRSim(ShapeHostMixin):
         Reference: refinement main.cpp:4960-5033, compression 5055-5194.
         """
         f = self.forest
+        # the prolongation/restriction gathers read the slot-layout
+        # fields — flush the ordered working state first (pre-regrid
+        # order is still valid here)
+        self.sync_fields()
         ordpos = {int(s): k for k, s in enumerate(self._order)}
         R, G = len(refine_keys), len(groups)
         # one executable per pad bucket: padding refine/compress rows to

@@ -311,10 +311,14 @@ def build_flux_corr(forest: Forest, order: np.ndarray,
 
 
 def apply_flux_corr(values: jnp.ndarray, deposits: jnp.ndarray,
-                    t: FluxCorrTables) -> jnp.ndarray:
+                    t) -> jnp.ndarray:
     """values: [N, BS, BS] or [N, dim, BS, BS] kernel output (ordered);
     deposits: [N, 4, BS] or [N, 4, BS, dim] from a `*_deposits` helper.
-    Returns corrected values (the reference's fillcases add)."""
+    Returns corrected values (the reference's fillcases add).
+    Dispatches to the shard-local apply for per-device correction rows
+    (parallel.shard_halo.ShardFluxCorr)."""
+    if hasattr(t, "apply"):
+        return t.apply(values, deposits)
     valid = t.valid.astype(values.dtype)
     if values.ndim == 3:
         flat = values.reshape(-1)

@@ -26,6 +26,28 @@ from .config import SimConfig
 from .curve import SpaceCurve
 
 
+class _FieldsDict(dict):
+    """Field store with a write-version counter.
+
+    The AMR driver keeps an SFC-ordered compact copy of the fields as
+    its per-step working state (amr.AMRSim._ordered_state) and syncs it
+    back lazily; ``wver`` lets it detect any external write to the
+    slot-layout dict (tests seeding a field, checkpoint restore) so a
+    stale ordered cache is never used."""
+
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self.wver = 0
+
+    def __setitem__(self, key, value):
+        self.wver += 1
+        super().__setitem__(key, value)
+
+    def update(self, *a, **k):
+        self.wver += 1
+        super().update(*a, **k)
+
+
 class Forest:
     """Host topology + device field storage for one AMR run.
 
@@ -55,7 +77,7 @@ class Forest:
         self.bj = np.zeros(self.capacity, np.int32)
         self.active = np.zeros(self.capacity, bool)
         self._free = list(range(self.capacity - 1, -1, -1))
-        self.fields: Dict[str, jnp.ndarray] = {}
+        self.fields: Dict[str, jnp.ndarray] = _FieldsDict()
         self.version = 0   # bumped on every topology change
 
         # initial uniform partition at level_start (main.cpp:6494-6541)

@@ -105,6 +105,9 @@ jax.tree_util.register_pytree_node(
 # regrid (entries are verified against each member's topology trace
 # before use — see build_tables)
 _TEMPLATE_CACHE: dict = {}
+# bound on templates summed across ALL keys (each template is a tuple
+# of ~13 small numpy arrays; see the eviction note in build_tables)
+_TEMPLATE_TOTAL_CAP = 8192
 
 
 class _TopoIndex:
@@ -381,13 +384,18 @@ def build_tables(forest: Forest, order: np.ndarray, g: int,
         # from itself, so the loop always terminates). This replaces the
         # r2 per-regrid naive fallback — deep variants now cost the
         # expression build ONCE instead of at every regrid.
-        cands = _TEMPLATE_CACHE.get(cache_base + (key,))
+        ck = cache_base + (key,)
+        cands = _TEMPLATE_CACHE.get(ck)
         if cands is None:
-            # bounded FIFO: evict oldest (insertion-ordered dict) so the
+            # bounded LRU: evict oldest (insertion-ordered dict) so the
             # steady-state hot set survives the cap, unlike a clear()
             while len(_TEMPLATE_CACHE) >= 2048:
                 del _TEMPLATE_CACHE[next(iter(_TEMPLATE_CACHE))]
-            cands = _TEMPLATE_CACHE[cache_base + (key,)] = []
+            cands = _TEMPLATE_CACHE[ck] = []
+        else:
+            # refresh recency — reads must protect the every-regrid hot
+            # set from both eviction paths (key-count and total-template)
+            _TEMPLATE_CACHE[ck] = _TEMPLATE_CACHE.pop(ck)
 
         remaining = np.asarray(members)
         ti = 0
@@ -402,6 +410,24 @@ def build_tables(forest: Forest, order: np.ndarray, g: int,
                 # serve the current call.
                 tpl = make_template(int(remaining[0]))
                 if len(cands) < 64:
+                    # cap TOTAL templates, not just keys: each key may
+                    # hold up to 64 variants of ~13 arrays, so a
+                    # key-only bound admits a ~64x footprint blow-up on
+                    # pathological forests (ADVICE r2). Evict whole
+                    # oldest keys (LRU — reads refresh recency above),
+                    # skipping the live list rather than stopping at it
+                    # so the cap still binds when it happens to be
+                    # oldest.
+                    while (sum(len(v) for v in _TEMPLATE_CACHE.values())
+                           >= _TEMPLATE_TOTAL_CAP):
+                        victim = None
+                        for k0, v in _TEMPLATE_CACHE.items():
+                            if v is not cands:
+                                victim = k0
+                                break
+                        if victim is None:
+                            break          # only the live list remains
+                        del _TEMPLATE_CACHE[victim]
                     cands.append(tpl)
                     ti += 1
             (role_arr, s_dest, s_role, s_cell, s_sign,
@@ -561,9 +587,14 @@ def assemble_labs(field: jnp.ndarray, order, tables: HaloTables):
     return _place(field[order], simple, general, t, bs)
 
 
-def assemble_labs_ordered(x: jnp.ndarray, tables: HaloTables):
+def assemble_labs_ordered(x: jnp.ndarray, tables):
     """Same, for an operand already in SFC-ordered compact layout
-    [n_active, dim, BS, BS] (Poisson Krylov vectors)."""
+    [n_active, dim, BS, BS] (Poisson Krylov vectors). Dispatches to the
+    shard-local assembly when given per-device tables
+    (parallel.shard_halo.ShardTables — explicit surface exchange
+    instead of a GSPMD whole-field all-gather)."""
+    if hasattr(tables, "assemble"):
+        return tables.assemble(x)
     n, dim, bs, _ = x.shape
     t = tables
     flat = x.transpose(1, 0, 2, 3).reshape(dim, n * bs * bs)

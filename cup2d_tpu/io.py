@@ -146,6 +146,10 @@ def save_checkpoint(dirpath: str, sim) -> None:
         import shutil
         shutil.rmtree(tmp)
     os.makedirs(tmp)
+    if hasattr(sim, "sync_fields"):
+        # the adaptive driver's per-step truth is its ordered working
+        # state; flush it into the slot-layout dict read below
+        sim.sync_fields()
     if hasattr(sim, "forest"):
         # adaptive: topology as (level, i, j) keys + fields in SFC order
         # (slot numbering is an allocator detail that need not survive)

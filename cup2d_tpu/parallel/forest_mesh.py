@@ -25,6 +25,8 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..amr import AMRSim
@@ -56,10 +58,11 @@ class ShardedAMRSim(AMRSim):
             return
         super()._refresh()
         shard = NamedSharding(self.mesh, P("x"))
-        repl = NamedSharding(self.mesh, P())
         # fields: shard the slot axis (capacity is a power-of-two-ish
         # multiple of the mesh size); compact per-block arrays: shard
-        # the padded ordered axis (n_pad is a power of two >= 128)
+        # the padded ordered axis (n_pad is a power of two >= 128).
+        # Hot-loop gather tables are split per device by the
+        # _finalize_tables/_finalize_corr hooks below.
         for name, fld in f.fields.items():
             f.fields[name] = jax.device_put(fld, shard)
         self._h = jax.device_put(self._h, shard)
@@ -70,21 +73,89 @@ class ShardedAMRSim(AMRSim):
         self._xc = jax.device_put(self._xc, shard)
         self._yc = jax.device_put(self._yc, shard)
         self._order_j = jax.device_put(self._order_j, shard)
-        # gather tables are index metadata: replicated, like the
-        # reference replicating its synchronizer plans per rank
-        self._tables = {k: jax.device_put(t, repl)
-                        for k, t in self._tables.items()}
-        self._corr = jax.device_put(self._corr, repl)
+
+    def _finalize_tables(self, raw, n_pad):
+        """Hot-loop table sets become per-device rows + a surface
+        exchange plan (shard_halo) — the reference's per-rank
+        synchronizer plans (main.cpp:909-1391). The regrid prolongation
+        sets (vec1t/sca1t) read slot-layout fields outside the sharded
+        hot loop and stay replicated."""
+        from .shard_halo import shard_tables
+        if n_pad % self.mesh.devices.size:
+            return super()._finalize_tables(raw, n_pad)
+        from ..halo import pad_tables
+        repl = NamedSharding(self.mesh, P())
+        padded = {k: pad_tables(raw[k], n_pad)
+                  for k in ("vec1t", "sca1t") if k in raw}
+        out = dict(jax.device_put(padded, repl))
+        for k, t in raw.items():
+            if k not in padded:
+                out[k] = shard_tables(t, n_pad, self.mesh)
+        return out
+
+    def _finalize_corr(self, topo, n_pad):
+        from ..flux import build_flux_corr
+        from .shard_halo import shard_flux_corr
+        if n_pad % self.mesh.devices.size:
+            return super()._finalize_corr(topo, n_pad)
+        raw = build_flux_corr(self.forest, self._order, topo=topo)
+        return shard_flux_corr(raw, n_pad, self.mesh, self.cfg.bs,
+                               dtype=np.dtype(self.forest.dtype))
+
+    def _window_raster(self, inp, xc, yc, neg, N):
+        """Window rasterization with a shard-local scatter: every device
+        evaluates the (small, body-sized) window SDF/udef replicated,
+        then keeps only the rows landing in its own block range — no
+        collective at all, vs the volume-sized scatter all-reduce GSPMD
+        emits for the global form (validation/comm_audit.py)."""
+        D = self.mesh.devices.size
+        if N % D:
+            return super()._window_raster(inp, xc, yc, neg, N)
+        from functools import partial
+
+        from ..amr import _window_sdf_udef
+        bs = self.cfg.bs
+        dtype = self.forest.dtype
+        B = N // D
+
+        @partial(jax.shard_map, mesh=self.mesh,
+                 in_specs=(P(),),
+                 out_specs=(P("x"), P(None, "x"), P("x")))
+        def win(inp_r):
+            d0 = jax.lax.axis_index("x")
+            d, ud = _window_sdf_udef(inp_r, bs, dtype)
+            pos = inp_r["pos"]
+            mine = (pos >= d0 * B) & (pos < (d0 + 1) * B)
+            lpos = jnp.where(mine, pos - d0 * B, B)
+            wm3 = mine[:, None, None]
+            # concrete constant (shard_map must not close over tracers)
+            negd = jnp.asarray(-float(self.cfg.extent), dtype)
+            sdf_k = jnp.full((B + 1, bs, bs), negd, dtype).at[lpos].set(
+                jnp.where(wm3, d, negd))[:B]
+            udef_k = jnp.zeros(
+                (2, B + 1, bs, bs), dtype).at[:, lpos].set(
+                jnp.where(wm3[None], ud, 0.0))[:, :B]
+            wm_k = jnp.zeros((B + 1,), dtype).at[lpos].set(
+                mine.astype(dtype))[:B]
+            return sdf_k, udef_k, wm_k
+
+        return win(inp)
+
+    def _put_ordered(self, x):
+        """The ordered working state owns the hot loop — place it in
+        contiguous SFC ranges over the mesh (the reference's rank
+        partition, main.cpp:5205-5330)."""
+        return jax.device_put(x, NamedSharding(self.mesh, P("x")))
 
     # -- sharding constraints inside the jitted stages -----------------
-    def _advect_rk2(self, vel, order, h, dt, t3, corr, maskv):
-        v = super()._advect_rk2(vel, order, h, dt, t3, corr, maskv)
+    def _advect_rk2(self, vel, h, dt, t3, corr, maskv):
+        v = super()._advect_rk2(vel, h, dt, t3, corr, maskv)
         return self._shard_blocks(v)
 
-    def _pressure_project(self, vel, v, pres, dt, order, h, hsq,
+    def _pressure_project(self, v, pres, dt, h, hsq,
                           t1v, t1s, tpois, corr, exact_poisson, maskv,
                           chi=None, udef_b=None):
         v = self._shard_blocks(v)
         return super()._pressure_project(
-            vel, v, pres, dt, order, h, hsq, t1v, t1s, tpois, corr,
+            v, pres, dt, h, hsq, t1v, t1s, tpois, corr,
             exact_poisson, maskv, chi=chi, udef_b=udef_b)

@@ -33,7 +33,8 @@ from .mesh import make_mesh
 
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> int:
+                     process_id: Optional[int] = None,
+                     expected_processes: Optional[int] = None) -> int:
     """Bring up the JAX distributed runtime for a multi-host run (the
     reference's MPI_Init moment, main.cpp:6307).
 
@@ -45,30 +46,61 @@ def init_distributed(coordinator_address: Optional[str] = None,
     XLA and make a later initialize() impossible. Init failures (e.g.
     unreachable coordinator) propagate: a pod run silently degrading to
     independent single-host runs computes wrong answers with no error.
-    Returns this process's index.
+
+    ``expected_processes`` is the belt-and-braces guard against exactly
+    that degradation on launchers whose environment the pod heuristics
+    don't recognize (ADVICE r2): pass the known world size (e.g. the
+    `-mesh-hosts` flag / slurm's SLURM_NPROCS) and the call aborts
+    unless that many processes actually joined. Returns this process's
+    index.
     """
     if jax.distributed.is_initialized():
-        return jax.process_index()   # launcher already brought it up
-    explicit = (coordinator_address is not None
-                or num_processes is not None)
-    if not explicit and not _in_tpu_pod():
-        return 0
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id)
-    return jax.process_index()
+        rank = jax.process_index()
+    else:
+        explicit = (coordinator_address is not None
+                    or num_processes is not None)
+        if not explicit and not _in_tpu_pod():
+            if expected_processes and expected_processes > 1:
+                raise RuntimeError(
+                    f"expected {expected_processes} processes but no "
+                    "pod environment was detected and no coordinator "
+                    "was given — refusing to run single-host silently")
+            return 0
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+        rank = jax.process_index()
+    if expected_processes and jax.process_count() != expected_processes:
+        raise RuntimeError(
+            f"distributed runtime has {jax.process_count()} processes, "
+            f"expected {expected_processes} — partial pod bring-up")
+    return rank
 
 
 def _in_tpu_pod() -> bool:
     """True when this process is one worker of a multi-host TPU slice
     (the autodetection case for jax.distributed.initialize). A
     single-entry TPU_WORKER_HOSTNAMES means a single-host slice — the
-    runtime also sets it there, so only a multi-hostname list counts."""
+    runtime also sets it there, so only a multi-hostname list counts.
+    Several launcher generations are covered (ADVICE r2: relying on one
+    env var silently degrades on the others): classic TPU_WORKER_
+    HOSTNAMES, megascale coordinators, and GKE/queued-resource runtimes
+    that export per-worker ids with a >1 worker count."""
     import os
     hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
-    return ("," in hosts) or bool(
-        os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+    if "," in hosts:
+        return True
+    if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        return True
+    for nvar in ("TPU_WORKER_COUNT", "NUM_TPU_WORKERS",
+                 "CLOUD_TPU_NUM_WORKERS"):
+        try:
+            if int(os.environ.get(nvar, "1")) > 1:
+                return True
+        except ValueError:
+            pass
+    return False
 
 
 def global_mesh():

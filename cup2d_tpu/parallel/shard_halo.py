@@ -1,0 +1,341 @@
+"""Shard-local ghost assembly: the explicit halo exchange.
+
+GSPMD lowers `halo.assemble_labs_ordered`'s data-dependent gather from
+a block-sharded operand to an **all-gather of the entire field** —
+traffic proportional to shard volume, several times per step and once
+per Krylov iteration (measured: validation/comm_audit.py). The
+reference's comm layer exists precisely to avoid that: it ships only
+halo slabs between neighbor ranks (/root/reference/main.cpp:909-2142,
+Setup/sync1), so per-rank traffic scales with the shard *boundary*.
+
+This module restores that scaling law on the device mesh:
+
+* gather tables are split per device — rows whose destination block
+  lives in shard d become d's rows, with every gather source remapped
+  into a local index space = [d's own B blocks] ++ [an all-gathered
+  SURFACE buffer];
+* the surface buffer packs only blocks some OTHER shard references —
+  the shard-boundary halo (SFC-contiguous shards keep it thin, the
+  same locality argument as the reference's SFC rank ranges);
+* assembly runs under `shard_map`: pack own surface blocks, ONE
+  `lax.all_gather` of the packed buffer over the mesh axis, then purely
+  local gathers/scatters.
+
+The flux-correction fix-up (fine-face deposits added into coarse rows,
+main.cpp:1392-1849) gets the identical treatment with face-deposit rows
+as the exchanged payload.
+
+Per-device row counts and surface sizes are padded to power-of-two
+buckets so regrids reuse compiled executables (same rationale as
+halo.pad_tables).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..halo import HaloTables, _bucket
+
+
+class ShardTables(NamedTuple):
+    """Per-device halo tables (leaves stacked [D, ...], sharded on the
+    mesh axis so each device reads only its own rows inside shard_map).
+
+    Index spaces (per device d owning ordered blocks [dB, dB+B)):
+      gather sources: flat cells of [B own blocks ++ D*S surface blocks]
+      scatter dests:  flat cells of [B labs] ++ 1 trailing scratch cell
+                      (pad rows write zeros there; dropped on return)
+    """
+
+    pack: jnp.ndarray     # [D, S] int32 own-block indices to export
+    src: jnp.ndarray      # [D, Gs] int32
+    sign: jnp.ndarray     # [D, Gs, dim]
+    dest_s: jnp.ndarray   # [D, Gs] int32
+    dest: jnp.ndarray     # [D, Gg] int32
+    idx: jnp.ndarray      # [D, Gg, K] int32
+    w: jnp.ndarray        # [D, Gg, K, dim]
+    mesh: Mesh
+    B: int                # blocks per device
+    S: int                # surface bucket
+    L: int
+    g: int
+    dim: int
+
+    def assemble(self, x: jnp.ndarray) -> jnp.ndarray:
+        return _assemble_sharded(x, self)
+
+
+jax.tree_util.register_pytree_node(
+    ShardTables,
+    lambda t: ((t.pack, t.src, t.sign, t.dest_s, t.dest, t.idx, t.w),
+               (t.mesh, t.B, t.S, t.L, t.g, t.dim)),
+    lambda aux, ch: ShardTables(*ch, *aux),
+)
+
+
+def shard_tables(t: HaloTables, n_pad: int, mesh: Mesh) -> ShardTables:
+    """Split (unpadded, numpy-leaf) tables into per-device rows with a
+    surface-buffer exchange plan. ``n_pad`` must divide by the mesh
+    size (amr buckets are powers of two >= 128)."""
+    D = mesh.devices.size
+    assert n_pad % D == 0, (n_pad, D)
+    B = n_pad // D
+    L, g, dim = t.L, t.g, t.dim
+    bs = L - 2 * g
+    bs2 = bs * bs
+    LL = L * L
+
+    dest_s = np.asarray(t.dest_s, np.int64)
+    src = np.asarray(t.src_ord, np.int64)
+    sign = np.asarray(t.sign)
+    dest = np.asarray(t.dest, np.int64)
+    idx = np.asarray(t.idx_ord, np.int64)
+    w = np.asarray(t.w)
+    K = idx.shape[1]
+
+    # zero-weight K-padding entries must not create surface demand
+    zmask = (w == 0).all(axis=2)                       # [Gg, K]
+
+    dev_s = (dest_s // LL) // B
+    dev_g = (dest // LL) // B
+    src_blk = src // bs2
+    idx_blk = idx // bs2
+
+    # -- surface sets ----------------------------------------------------
+    # blocks referenced by rows of device d but owned elsewhere
+    surf_lists: list[list[int]] = [[] for _ in range(D)]
+    surf_pos: dict[int, int] = {}
+    for d in range(D):
+        ref = np.concatenate([
+            src_blk[dev_s == d],
+            idx_blk[dev_g == d][~zmask[dev_g == d]],
+        ])
+        remote = np.unique(ref[(ref < d * B) | (ref >= (d + 1) * B)])
+        for gblk in remote.tolist():
+            if gblk not in surf_pos:
+                e = gblk // B
+                surf_pos[gblk] = len(surf_lists[e])   # position within e
+                surf_lists[e].append(gblk)
+    S = _bucket(max((len(x) for x in surf_lists), default=1), lo=4)
+    pack = np.zeros((D, S), np.int32)
+    for e, lst in enumerate(surf_lists):
+        pack[e, :len(lst)] = np.asarray(lst, np.int64) - e * B
+    # global block -> index into the all-gathered [D*S] surface buffer
+    g2surf = np.full(n_pad, -1, np.int64)
+    for gblk, p in surf_pos.items():
+        g2surf[gblk] = (gblk // B) * S + p
+
+    def remap_cells(cells, d, dead_local=None):
+        blk = cells // bs2
+        off = cells % bs2
+        local = (blk >= d * B) & (blk < (d + 1) * B)
+        sidx = g2surf[np.clip(blk, 0, n_pad - 1)]
+        out = np.where(local, (blk - d * B) * bs2 + off,
+                       (B + sidx) * bs2 + off)
+        if dead_local is not None:
+            out = np.where(dead_local, 0, out)
+        bad = (~local) & (sidx < 0)
+        if dead_local is not None:
+            bad &= ~dead_local
+        assert not bad.any(), "gather source missing from surface set"
+        return out
+
+    # -- per-device rows, bucketed ---------------------------------------
+    Gs = _bucket(max(int((dev_s == d).sum()) for d in range(D)), lo=4)
+    Gg = _bucket(max(int((dev_g == d).sum()) for d in range(D)), lo=4)
+    scratch = B * LL
+    f32 = sign.dtype
+    pk_src = np.zeros((D, Gs), np.int32)
+    pk_sign = np.zeros((D, Gs, dim), f32)
+    pk_dest_s = np.full((D, Gs), scratch, np.int32)
+    pk_dest = np.full((D, Gg), scratch, np.int32)
+    pk_idx = np.zeros((D, Gg, K), np.int32)
+    pk_w = np.zeros((D, Gg, K, dim), f32)
+    for d in range(D):
+        rs = np.nonzero(dev_s == d)[0]
+        rg = np.nonzero(dev_g == d)[0]
+        ns, ng = len(rs), len(rg)
+        pk_src[d, :ns] = remap_cells(src[rs], d)
+        pk_sign[d, :ns] = sign[rs]
+        pk_dest_s[d, :ns] = dest_s[rs] - d * B * LL
+        pk_dest[d, :ng] = dest[rg] - d * B * LL
+        pk_idx[d, :ng] = remap_cells(
+            idx[rg], d, dead_local=zmask[rg]).reshape(ng, K)
+        pk_w[d, :ng] = w[rg]
+
+    return _put_shard_tables(mesh, ShardTables(
+        pack=pack, src=pk_src, sign=pk_sign, dest_s=pk_dest_s,
+        dest=pk_dest, idx=pk_idx, w=pk_w,
+        mesh=mesh, B=B, S=S, L=L, g=g, dim=dim,
+    ))
+
+
+def _put_shard_tables(mesh: Mesh, t):
+    leaves, treedef = jax.tree_util.tree_flatten(t)
+    put = jax.device_put(
+        leaves, [NamedSharding(mesh, P("x"))] * len(leaves))
+    return jax.tree_util.tree_unflatten(treedef, put)
+
+
+def _assemble_sharded(x: jnp.ndarray, t: ShardTables) -> jnp.ndarray:
+    """[n_pad, dim, BS, BS] ordered field -> [n_pad, dim, L, L] labs,
+    sharded on the block axis; comm = one surface-buffer all-gather."""
+    D = t.mesh.devices.size
+    B, S, L, g, dim = t.B, t.S, t.L, t.g, t.dim
+    bs = L - 2 * g
+
+    @partial(jax.shard_map, mesh=t.mesh,
+             in_specs=(P("x"),) * 8, out_specs=P("x"))
+    def run(x_loc, pack, src, sign, dest_s, dest, idx, w):
+        pack, src, sign, dest_s, dest, idx, w = (
+            a[0] for a in (pack, src, sign, dest_s, dest, idx, w))
+        surf = x_loc[pack]                              # [S, dim, bs, bs]
+        asurf = jax.lax.all_gather(surf, "x")           # [D, S, ...]
+        blocks = jnp.concatenate(
+            [x_loc, asurf.reshape(D * S, dim, bs, bs)], axis=0)
+        flat = blocks.transpose(1, 0, 2, 3).reshape(dim, -1)
+        simple = flat[:, src].T * sign                  # [Gs, dim]
+        general = jnp.einsum("dgk,gkd->gd", flat[:, idx], w)
+        labs = jnp.zeros((B, dim, L, L), x_loc.dtype)
+        labs = labs.at[:, :, g:g + bs, g:g + bs].set(x_loc)
+        lf = labs.transpose(1, 0, 2, 3).reshape(dim, -1)
+        lf = jnp.concatenate(
+            [lf, jnp.zeros((dim, 1), x_loc.dtype)], axis=1)
+        lf = lf.at[:, dest_s].set(simple.T.astype(lf.dtype))
+        lf = lf.at[:, dest].set(general.T.astype(lf.dtype))
+        return lf[:, :-1].reshape(dim, B, L, L).transpose(1, 0, 2, 3)
+
+    return run(x, t.pack, t.src, t.sign, t.dest_s, t.dest, t.idx, t.w)
+
+
+# ---------------------------------------------------------------------------
+# flux correction (fine-face deposits -> coarse rows) across shards
+# ---------------------------------------------------------------------------
+
+class ShardFluxCorr(NamedTuple):
+    """Per-device flux-correction rows. Deposit index space per device:
+    [B own blocks ++ D*S surface blocks] x 4 faces x BS; value dests are
+    local cells [B*BS*BS] ++ 1 scratch."""
+
+    pack: jnp.ndarray    # [D, S] own-block indices whose deposits export
+    dest: jnp.ndarray    # [D, M]
+    cidx: jnp.ndarray    # [D, M]
+    fidx1: jnp.ndarray   # [D, M]
+    fidx2: jnp.ndarray   # [D, M]
+    valid: jnp.ndarray   # [D, M]
+    mesh: Mesh
+    B: int
+    S: int
+    bs: int
+
+    def apply(self, values, deposits):
+        return _apply_corr_sharded(values, deposits, self)
+
+
+jax.tree_util.register_pytree_node(
+    ShardFluxCorr,
+    lambda t: ((t.pack, t.dest, t.cidx, t.fidx1, t.fidx2, t.valid),
+               (t.mesh, t.B, t.S, t.bs)),
+    lambda aux, ch: ShardFluxCorr(*ch, *aux),
+)
+
+
+def shard_flux_corr(corr, n_pad: int, mesh: Mesh, bs: int,
+                    dtype=np.float32) -> ShardFluxCorr:
+    """Split (unpadded) FluxCorrTables by owning coarse block."""
+    D = mesh.devices.size
+    assert n_pad % D == 0
+    B = n_pad // D
+    bs2 = bs * bs
+    fb = 4 * bs                                   # deposit cells/block
+    dest = np.asarray(corr.dest, np.int64)
+    cidx = np.asarray(corr.cidx, np.int64)
+    f1 = np.asarray(corr.fidx1, np.int64)
+    f2 = np.asarray(corr.fidx2, np.int64)
+    dev = (dest // bs2) // B
+
+    surf_lists: list[list[int]] = [[] for _ in range(D)]
+    surf_pos: dict[int, int] = {}
+    for d in range(D):
+        ref = np.concatenate([a[dev == d] // fb for a in (cidx, f1, f2)])
+        remote = np.unique(ref[(ref < d * B) | (ref >= (d + 1) * B)])
+        for gblk in remote.tolist():
+            if gblk not in surf_pos:
+                surf_pos[gblk] = len(surf_lists[gblk // B])
+                surf_lists[gblk // B].append(gblk)
+    S = _bucket(max((len(x) for x in surf_lists), default=1), lo=4)
+    pack = np.zeros((D, S), np.int32)
+    for e, lst in enumerate(surf_lists):
+        pack[e, :len(lst)] = np.asarray(lst, np.int64) - e * B
+    g2surf = np.full(n_pad, -1, np.int64)
+    for gblk, p in surf_pos.items():
+        g2surf[gblk] = (gblk // B) * S + p
+
+    def remap_dep(cells, d):
+        blk = cells // fb
+        off = cells % fb
+        local = (blk >= d * B) & (blk < (d + 1) * B)
+        sidx = g2surf[np.clip(blk, 0, n_pad - 1)]
+        assert not ((~local) & (sidx < 0)).any()
+        return np.where(local, (blk - d * B) * fb + off,
+                        (B + sidx) * fb + off)
+
+    M = _bucket(max(int((dev == d).sum()) for d in range(D)), lo=4)
+    scratch = B * bs2
+    pk_dest = np.full((D, M), scratch, np.int32)
+    pk_c = np.zeros((D, M), np.int32)
+    pk_f1 = np.zeros((D, M), np.int32)
+    pk_f2 = np.zeros((D, M), np.int32)
+    pk_v = np.zeros((D, M), dtype)
+    for d in range(D):
+        r = np.nonzero(dev == d)[0]
+        n = len(r)
+        pk_dest[d, :n] = dest[r] - d * B * bs2
+        pk_c[d, :n] = remap_dep(cidx[r], d)
+        pk_f1[d, :n] = remap_dep(f1[r], d)
+        pk_f2[d, :n] = remap_dep(f2[r], d)
+        pk_v[d, :n] = 1.0
+    return _put_shard_tables(mesh, ShardFluxCorr(
+        pack=pack, dest=pk_dest, cidx=pk_c, fidx1=pk_f1, fidx2=pk_f2,
+        valid=pk_v, mesh=mesh, B=B, S=S, bs=bs,
+    ))
+
+
+def _apply_corr_sharded(values, deposits, t: ShardFluxCorr):
+    D = t.mesh.devices.size
+    B, S, bs = t.B, t.S, t.bs
+    vec = values.ndim == 4
+
+    @partial(jax.shard_map, mesh=t.mesh,
+             in_specs=(P("x"),) * 8, out_specs=P("x"))
+    def run(v_loc, d_loc, pack, dest, cidx, f1, f2, valid):
+        pack, dest, cidx, f1, f2, valid = (
+            a[0] for a in (pack, dest, cidx, f1, f2, valid))
+        surf = d_loc[pack]
+        asurf = jax.lax.all_gather(surf, "x")
+        dep = jnp.concatenate(
+            [d_loc, asurf.reshape((D * S,) + d_loc.shape[1:])], axis=0)
+        if vec:
+            dim = v_loc.shape[1]
+            df = dep.reshape(-1, dim)
+            corr = valid[:, None].astype(v_loc.dtype) * (
+                df[cidx] + df[f1] + df[f2])
+            flat = v_loc.transpose(0, 2, 3, 1).reshape(-1, dim)
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((1, dim), v_loc.dtype)], axis=0)
+            out = flat.at[dest].add(corr)[:-1]
+            return out.reshape(B, bs, bs, dim).transpose(0, 3, 1, 2)
+        df = dep.reshape(-1)
+        corr = valid.astype(v_loc.dtype) * (df[cidx] + df[f1] + df[f2])
+        flat = jnp.concatenate(
+            [v_loc.reshape(-1), jnp.zeros((1,), v_loc.dtype)])
+        return flat.at[dest].add(corr)[:-1].reshape(B, bs, bs)
+
+    return run(values, deposits, t.pack, t.dest, t.cidx, t.fidx1,
+               t.fidx2, t.valid)

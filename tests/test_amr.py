@@ -141,6 +141,7 @@ def test_amr_two_level_taylor_green():
     _fill_tg(sim)
 
     def energy():
+        sim.sync_fields()
         return sum(
             float(jnp.sum(f.fields["vel"][s] ** 2)) * cfg.h_at(l) ** 2
             for (l, i, j), s in f.blocks.items())
@@ -190,6 +191,7 @@ def test_amr_dynamic_adapt_vortex():
             sim.adapt()
         d = sim.step_once()
     assert np.isfinite(float(d["umax"]))
+    sim.sync_fields()
     vel = np.asarray(f.fields["vel"])
     assert np.isfinite(vel[f.active]).all()
 

@@ -57,6 +57,7 @@ def test_disk_forest_matches_uniform():
         asim.step_once(dt=2e-3)
         usim.step_once(dt=2e-3)
 
+    asim.sync_fields()
     f = asim.forest
     bs = cfg.bs
     gv = np.asarray(usim.state.vel)
@@ -128,6 +129,8 @@ def test_amr_checkpoint_roundtrip(tmp_path):
 
     sim.step_once(dt=1e-3)
     sim2.step_once(dt=1e-3)
+    sim.sync_fields()
+    sim2.sync_fields()
     a = np.asarray(sim.forest.fields["vel"][sim.forest.order()])
     b = np.asarray(sim2.forest.fields["vel"][sim2.forest.order()])
     assert np.abs(a - b).max() < 1e-12

@@ -300,5 +300,6 @@ def test_amr_taylor_green_two_level():
     e0 = float(jnp.sum(vel[order] ** 2))
     for _ in range(5):
         diag = sim.step_once(dt=1e-3)
+    sim.sync_fields()
     e1 = float(jnp.sum(f.fields["vel"][order] ** 2))
     assert np.isfinite(e1) and 0 < e1 < e0  # viscous decay, no blowup
