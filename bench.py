@@ -194,7 +194,54 @@ def run_size(size: int, n_warmup: int, n_steps: int):
         "mfu_pct": round(flops / wall / (PEAK_F32_TFLOPS * 1e12) * 100, 3),
         "hbm_util_pct": round(bytes_ / wall / (PEAK_HBM_GBPS * 1e9) * 100, 1),
         "latency_bound": latency_bound,
+        **_profiled_step(step, state, dt, cells),
     }
+
+
+def _profiled_step(step, state, dt, cells: int) -> dict:
+    """Profiler-measured step time (VERDICT r2 #3: measured, not
+    modeled): capture a short jax.profiler trace of the warmed step and
+    read the XLA-module device time from the xplane dump. The HBM
+    figure divides the IDEAL traffic floor (the same per-cell byte
+    model) by the MEASURED device time — i.e. it is an upper bound on
+    achievable utilization; the gap to 100% is arithmetic (VPU), op
+    overhead, or redundant traffic. Skipped silently where the profiler
+    or its protobufs are unavailable."""
+    import glob
+    import shutil
+    import tempfile
+    d = tempfile.mkdtemp(prefix="cup2d_bench_trace_")
+    try:
+        reps = 3
+        with jax.profiler.trace(d):
+            s = state
+            for _ in range(reps):
+                s, _diag = step(s, dt)
+            _fence(s.vel)
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+        paths = glob.glob(os.path.join(
+            d, "plugins", "profile", "*", "*.xplane.pb"))
+        xs = xplane_pb2.XSpace()
+        xs.ParseFromString(open(paths[0], "rb").read())
+        plane = next(p for p in xs.planes
+                     if p.name.startswith("/device:"))
+        durs = sorted(ev.duration_ps for line in plane.lines
+                      if line.name == "XLA Modules"
+                      for ev in line.events)
+        if not durs:
+            return {}
+        # median execution: per-rep Poisson iteration counts vary
+        dev_s = durs[len(durs) // 2] / 1e12
+        floor_bytes = cells * BYTES_STEP_PER_CELL
+        return {
+            "device_step_ms_profiled": round(dev_s * 1e3, 3),
+            "hbm_util_profiled_pct": round(
+                floor_bytes / dev_s / (PEAK_HBM_GBPS * 1e9) * 100, 1),
+        }
+    except Exception:
+        return {}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def main():

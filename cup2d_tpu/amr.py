@@ -52,9 +52,11 @@ from .ops.collision import merged_overlap_integrals, \
 from .ops.forces import surface_forces_blocks
 from .ops.obstacle import (
     chi_from_sdf,
-    midline_udef,
+    midline_udef_packed,
+    pack_midline,
+    pack_polygon_segments,
     penalization_integrals,
-    polygon_sdf,
+    polygon_sdf_seg,
     shape_integrals,
     solve_rigid_momentum,
 )
@@ -83,23 +85,34 @@ class ObstacleForestFields(NamedTuple):
     inertia: jnp.ndarray  # [S]
 
 
+def _raster_neg(cfg, dtype):
+    """The "far outside" SDF sentinel, ONE definition: the sharded
+    window raster cannot receive it as an argument (shard_map bodies
+    must not close over tracers), so both paths construct it from the
+    config through this function and provably agree."""
+    return jnp.asarray(-float(cfg.extent), dtype)
+
+
 def _window_sdf_udef(inp, bs: int, dtype):
     """Evaluate one shape's SDF + deformation velocity over its window
     blocks ([P, BS, BS] / [2, P, BS, BS]) from the window-block origins
     shipped in ``inp`` (PutFishOnBlocks, main.cpp:3774-3990). The ONE
     definition shared by the single-device scatter and the shard-local
     scatter (forest_mesh.ShardedAMRSim._window_raster) — the sharded ==
-    single-device equality tests assume bit-identical evaluation."""
+    single-device equality tests assume bit-identical evaluation.
+    Consumes the host-packed segment/midline tables (ops.obstacle.pack_*
+    — built in body frame, com already subtracted): the op-level trace
+    showed the unpacked form spending ~40% of megastep device time
+    staging tiny per-field shape arrays through scratch."""
     ar = jnp.arange(bs, dtype=dtype) + 0.5
     wh = inp["wh"][:, None, None]
     xw = inp["wx0"][:, None, None] + ar[None, None, :] * wh
     yw = inp["wy0"][:, None, None] + ar[None, :, None] * wh
     com = inp["com"]
-    d = polygon_sdf(xw - com[0], yw - com[1], inp["poly"] - com)
-    ud = midline_udef(
-        xw - com[0], yw - com[1], inp["mid_r"] - com,
-        inp["mid_v"], inp["mid_nor"], inp["mid_vnor"],
-        inp["width"])
+    px = xw - com[0]
+    py = yw - com[1]
+    d = polygon_sdf_seg(px, py, inp["seg"])
+    ud = midline_udef_packed(px, py, inp["mid"])
     return d, ud
 
 
@@ -348,6 +361,23 @@ class AMRSim(ShapeHostMixin):
         self._ord = {**self._ord, **updates}
         self._ord_dirty = True
 
+    @staticmethod
+    def _pull_blockwise(x) -> np.ndarray:
+        """Pull a block-axis-sharded device array to host numpy.
+
+        Multi-host pods can't np.asarray a sharded global array (shards
+        live on other processes) — every process must reach the SAME
+        host-side regrid decision from the SAME full tag vector (the
+        reference's update_boundary contract, main.cpp:1850-1970), so
+        the pull becomes an all-gather across processes there. Scalar
+        diagnostics stay plain device_get (reduction outputs are fully
+        replicated)."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            return np.asarray(
+                multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(x)
+
     # ------------------------------------------------------------------
     # shared device stages
     # ------------------------------------------------------------------
@@ -560,7 +590,7 @@ class AMRSim(ShapeHostMixin):
         bs = cfg.bs
         dtype = self.forest.dtype
         N = xc.shape[0]
-        neg = jnp.asarray(-float(cfg.extent), dtype)
+        neg = _raster_neg(cfg, dtype)
         S = len(self.shapes)
 
         # per-shape window rasterization, scattered into block layout
@@ -569,8 +599,7 @@ class AMRSim(ShapeHostMixin):
         per = []
         for k in range(S):
             inp = inputs[k]
-            sdf_k, udef_k, wm_k = self._window_raster(
-                inp, xc, yc, neg, N)
+            sdf_k, udef_k, wm_k = self._window_raster(inp, N)
             sdf = jnp.maximum(sdf, sdf_k)
             per.append((sdf_k, udef_k, wm_k, inp["com"]))
 
@@ -617,7 +646,7 @@ class AMRSim(ShapeHostMixin):
             inertia=jnp.stack(inertias),
         )
 
-    def _window_raster(self, inp, xc, yc, neg, N):
+    def _window_raster(self, inp, N):
         """SDF + deformation velocity of one shape over its window
         blocks, scattered into the ordered block layout (the PutFish-
         OnBlocks gather form, main.cpp:3774-3990). ShardedAMRSim
@@ -626,6 +655,7 @@ class AMRSim(ShapeHostMixin):
         so the two paths cannot drift apart numerically."""
         bs = self.cfg.bs
         dtype = self.forest.dtype
+        neg = _raster_neg(self.cfg, dtype)
         pos = inp["pos"]                 # [P], -1 = padding
         wmask = pos >= 0
         d, ud = _window_sdf_udef(inp, bs, dtype)
@@ -774,17 +804,20 @@ class AMRSim(ShapeHostMixin):
             wy0[:len(idx)] = y0[idx]
             wh[:len(idx)] = h[idx]
             mid_r, mid_v, mid_nor, mid_vnor = s.midline_comp_frame()
+            com = np.asarray(s.com, np.float64)
+            # packed body-frame tables (see _window_sdf_udef): com is
+            # subtracted host-side in f64 so the device sees two large
+            # operands instead of ~13 tiny derived arrays
+            seg = pack_polygon_segments(s.surface_polygon() - com)
+            mid = pack_midline(mid_r - com, mid_v, mid_nor, mid_vnor,
+                               s.width)
             out.append({
                 "pos": jnp.asarray(pos),
                 "wx0": jnp.asarray(wx0, dtype=dt_),
                 "wy0": jnp.asarray(wy0, dtype=dt_),
                 "wh": jnp.asarray(wh, dtype=dt_),
-                "poly": jnp.asarray(s.surface_polygon(), dtype=dt_),
-                "mid_r": jnp.asarray(mid_r, dtype=dt_),
-                "mid_v": jnp.asarray(mid_v, dtype=dt_),
-                "mid_nor": jnp.asarray(mid_nor, dtype=dt_),
-                "mid_vnor": jnp.asarray(mid_vnor, dtype=dt_),
-                "width": jnp.asarray(s.width, dtype=dt_),
+                "seg": jnp.asarray(seg, dtype=dt_),
+                "mid": jnp.asarray(mid, dtype=dt_),
                 "com": jnp.asarray(s.com, dtype=dt_),
             })
         return out
@@ -1092,12 +1125,12 @@ class AMRSim(ShapeHostMixin):
             finest = np.zeros(len(self._mask), bool)
             finest[:self._n_real] = \
                 f.level[self._order] == cfg.level_max - 1
-            tags = np.asarray(self._tags_jit(
+            tags = self._pull_blockwise(self._tags_jit(
                 ordf["vel"], ordf["chi"],
                 self._h, self._tables["vec1"], self._tables["sca4t"],
                 jnp.asarray(finest)))[:self._n_real]
         else:
-            tags = np.asarray(self._vorticity_jit(
+            tags = self._pull_blockwise(self._vorticity_jit(
                 ordf["vel"], self._h,
                 self._tables["vec1"]))[:self._n_real]
         order = self._order

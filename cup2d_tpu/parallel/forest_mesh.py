@@ -102,7 +102,7 @@ class ShardedAMRSim(AMRSim):
         return shard_flux_corr(raw, n_pad, self.mesh, self.cfg.bs,
                                dtype=np.dtype(self.forest.dtype))
 
-    def _window_raster(self, inp, xc, yc, neg, N):
+    def _window_raster(self, inp, N):
         """Window rasterization with a shard-local scatter: every device
         evaluates the (small, body-sized) window SDF/udef replicated,
         then keeps only the rows landing in its own block range — no
@@ -110,10 +110,10 @@ class ShardedAMRSim(AMRSim):
         emits for the global form (validation/comm_audit.py)."""
         D = self.mesh.devices.size
         if N % D:
-            return super()._window_raster(inp, xc, yc, neg, N)
+            return super()._window_raster(inp, N)
         from functools import partial
 
-        from ..amr import _window_sdf_udef
+        from ..amr import _raster_neg, _window_sdf_udef
         bs = self.cfg.bs
         dtype = self.forest.dtype
         B = N // D
@@ -128,8 +128,8 @@ class ShardedAMRSim(AMRSim):
             mine = (pos >= d0 * B) & (pos < (d0 + 1) * B)
             lpos = jnp.where(mine, pos - d0 * B, B)
             wm3 = mine[:, None, None]
-            # concrete constant (shard_map must not close over tracers)
-            negd = jnp.asarray(-float(self.cfg.extent), dtype)
+            # shared constructor (shard_map must not close over tracers)
+            negd = _raster_neg(self.cfg, dtype)
             sdf_k = jnp.full((B + 1, bs, bs), negd, dtype).at[lpos].set(
                 jnp.where(wm3, d, negd))[:B]
             udef_k = jnp.zeros(

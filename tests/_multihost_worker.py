@@ -1,0 +1,76 @@
+"""Worker for tests/test_multihost.py: one process of a 2-process
+jax.distributed run of ShardedAMRSim on CPU (4 virtual devices per
+process -> one 8-device global mesh). Prints one digest line per regrid
+cycle; the parent asserts both processes print identical digests — the
+reference's cross-rank state-agreement contract (update_boundary /
+update_blocks, /root/reference/main.cpp:1410-1970) expressed as a test.
+
+Usage: python tests/_multihost_worker.py <process_id> <coordinator_port>
+"""
+
+import hashlib
+import os
+import sys
+
+
+def main():
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2, process_id=pid)
+    import numpy as np
+
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.models import DiskShape
+    from cup2d_tpu.parallel.forest_mesh import ShardedAMRSim
+    from cup2d_tpu.parallel.launch import global_mesh, init_distributed
+
+    assert init_distributed(expected_processes=2) == pid
+    mesh = global_mesh()
+    assert mesh.devices.size == 8, mesh
+
+    cfg = SimConfig(bpdx=2, bpdy=1, level_max=3, level_start=1,
+                    extent=1.0, dtype="float64", nu=4e-5, lam=1e6,
+                    rtol=2.0, ctol=1.0)
+    sim = ShardedAMRSim(cfg, mesh, shapes=[DiskShape(0.08, 0.55, 0.25)])
+    sim.compute_forces_every = 0
+    sim.initialize()
+
+    def digest():
+        f = sim.forest
+        h = hashlib.sha256()
+        for key in sorted(f.blocks):
+            h.update(repr((key, int(f.level[f.blocks[key]]))).encode())
+        h.update(repr((sim._npad_hwm, sim._n_real)).encode())
+        # table plans: per-device row arrays of every sharded set plus
+        # the replicated prolongation tables
+        for name in sorted(sim._tables):
+            t = sim._tables[name]
+            if hasattr(t, "pack"):      # ShardTables
+                for leaf in (t.pack, t.src, t.dest_s, t.dest):
+                    h.update(np.asarray(
+                        sim._pull_blockwise(leaf)).tobytes())
+            else:                        # replicated HaloTables
+                h.update(np.asarray(t.dest_s).tobytes())
+                h.update(np.asarray(t.src).tobytes())
+        return h.hexdigest()
+
+    for cycle in range(3):
+        sim.adapt()
+        for _ in range(2):
+            sim.step_once(dt=1e-3)
+        print(f"DIGEST {cycle} {digest()}", flush=True)
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,7 +18,7 @@ from cup2d_tpu.config import SimConfig
 from cup2d_tpu.models import DiskShape
 from cup2d_tpu.parallel.forest_mesh import ShardedAMRSim
 from cup2d_tpu.parallel.mesh import make_mesh
-from validation.comm_audit import _COLL_RE, shape_bytes
+from validation.comm_audit import _COLL_RE
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")

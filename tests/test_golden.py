@@ -1,0 +1,48 @@
+"""Golden-trajectory regression (VERDICT r2 missing #4 / next #5).
+
+The invariant-style suite (conservation, convergence, equality across
+paths) passes even if the physics silently drifts; this test pins the
+actual trajectory of a small canonical two-fish run — fish CoM and
+rigid-body state, umax, block count at fixed steps — against numbers
+recorded in golden_canonical.json by `python -m validation.golden
+--write`. A legitimate numerics change (new discretization, tolerance
+change) must consciously re-golden; anything else that moves these
+values is a regression."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from validation.golden import CHECK_STEPS, GOLDEN_PATH, run_trajectory
+
+
+@pytest.mark.skipif(not os.path.exists(GOLDEN_PATH),
+                    reason="golden_canonical.json not generated")
+def test_golden_canonical_trajectory():
+    with open(GOLDEN_PATH) as f:
+        want = json.load(f)
+    got = run_trajectory()
+    assert set(want) == {str(s) for s in CHECK_STEPS}
+    for step, w in want.items():
+        g = got[step]
+        # topology and solver behavior: exact / near-exact
+        assert g["n_blocks"] == w["n_blocks"], \
+            (step, g["n_blocks"], w["n_blocks"])
+        assert abs(g["poisson_iters"] - w["poisson_iters"]) <= 1, \
+            (step, g["poisson_iters"], w["poisson_iters"])
+        # trajectory: f64 on CPU is deterministic; the loose-ish floors
+        # absorb benign instruction-order changes across XLA releases
+        np.testing.assert_allclose(g["time"], w["time"], rtol=1e-12)
+        np.testing.assert_allclose(g["umax"], w["umax"],
+                                   rtol=1e-7, atol=1e-12)
+        for k, (fg, fw) in enumerate(zip(g["fish"], w["fish"])):
+            np.testing.assert_allclose(
+                fg["com"], fw["com"], rtol=0, atol=1e-8,
+                err_msg=f"step {step} fish {k} CoM")
+            np.testing.assert_allclose(
+                [fg["u"], fg["v"], fg["omega"]],
+                [fw["u"], fw["v"], fw["omega"]],
+                rtol=1e-6, atol=1e-10,
+                err_msg=f"step {step} fish {k} rigid state")

@@ -1,0 +1,132 @@
+"""Device-time probe of the canonical adaptive case (VERDICT r2 #2).
+
+Every round-2 adaptive measurement was tunnel-wall time: one megastep
+dispatch + one scalar pull per step costs ~2 tunnel round trips
+(~100 ms each), swamping device compute. This probe separates the two:
+after warming the canonical two-fish levelMax-8 case, it re-dispatches
+the megastep N times back-to-back with the velocity/pressure outputs
+chained into the next call's inputs (raster windows, dt and shape
+kinematics frozen — legal: all block-level work including the Poisson
+while_loop still runs), fencing ONCE at the end. Wall/N then bounds the
+true device time per step; the same chain fenced per-call reproduces
+the tunnel-bound number for contrast.
+
+    python -m validation.device_time [--steps 60] [--chain 20]
+
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fence(x) -> float:
+    return float(x.reshape(-1)[0])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60,
+                    help="normal warm-up steps before probing")
+    ap.add_argument("--chain", type=int, default=20)
+    ap.add_argument("--levelmax", type=int, default=8)
+    args = ap.parse_args()
+
+    from cup2d_tpu.cache import enable_compilation_cache
+    enable_compilation_cache()
+    from validation.canonical import build_canonical_sim
+
+    sim = build_canonical_sim(levelmax=args.levelmax)
+    cfg = sim.cfg
+
+    t0 = time.perf_counter()
+    sim.initialize()
+    t_init = time.perf_counter() - t0
+
+    # warm run: real driver loop (regrids + megasteps), median wall/step
+    walls = []
+    for k in range(args.steps):
+        if sim.step_count <= 10 or sim.step_count % cfg.adapt_steps == 0:
+            sim.adapt()
+        t0 = time.perf_counter()
+        sim.step_once()
+        walls.append(time.perf_counter() - t0)
+    n_blocks = len(sim.forest.blocks)
+    warm_ms = float(np.median(walls[min(10, len(walls) // 2):]) * 1e3)
+
+    # frozen-input chained dispatches: device time per megastep
+    sim._refresh()
+    ordf = sim._ordered_state()
+    inputs = sim._shape_inputs()
+    f = sim.forest
+    prescribed = jnp.asarray(
+        [[s.u, s.v, s.omega] for s in sim.shapes], dtype=f.dtype)
+    dt = jnp.asarray(sim._next_dt or sim.compute_dt(), f.dtype)
+    hmin = jnp.asarray(
+        cfg.h_at(int(f.level[sim._order].max())), f.dtype)
+
+    def mega(vel, pres):
+        return sim._mega_jit(
+            vel, pres, inputs, prescribed, dt, hmin,
+            sim._h, sim._hsq_flat, sim._maskv, sim._xc, sim._yc,
+            sim._tables["vec3"], sim._tables["vec1"],
+            sim._tables["sca1"], sim._tables["pois"],
+            sim._tables.get("vec4t"), sim._tables.get("sca4t"),
+            sim._corr, exact_poisson=False, with_forces=False)
+
+    vel, pres = ordf["vel"], ordf["pres"]
+    out = mega(vel, pres)          # compile/warm this exact signature
+    _fence(out[0])
+    # latency floor of one fenced readback
+    lat = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _fence(out[0])
+        lat.append(time.perf_counter() - t0)
+    lat_floor = min(lat)
+
+    best = None
+    for _ in range(3):
+        v, p = vel, pres
+        t0 = time.perf_counter()
+        for _ in range(args.chain):
+            v, p, _, scal, _ = mega(v, p)
+        _fence(v)
+        w = time.perf_counter() - t0 - lat_floor
+        best = w if best is None else min(best, w)
+    dev_ms = best / args.chain * 1e3
+
+    # contrast: same chain, fenced every call (the per-step tunnel cost)
+    v, p = vel, pres
+    t0 = time.perf_counter()
+    for _ in range(args.chain):
+        v, p, _, scal, _ = mega(v, p)
+        _fence(v)
+    per_call_ms = (time.perf_counter() - t0) / args.chain * 1e3
+
+    cells = n_blocks * cfg.bs * cfg.bs
+    print(json.dumps({
+        "case": f"two-fish levelMax={args.levelmax} (run.sh)",
+        "backend": jax.default_backend(),
+        "n_blocks": n_blocks,
+        "n_pad": int(sim._npad_hwm),
+        "init_s": round(t_init, 1),
+        "warm_step_wall_ms": round(warm_ms, 1),
+        "device_ms_per_megastep": round(dev_ms, 2),
+        "fenced_ms_per_megastep": round(per_call_ms, 1),
+        "latency_floor_ms": round(lat_floor * 1e3, 1),
+        "cells_steps_per_sec_device": round(cells / (dev_ms / 1e3)),
+    }))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

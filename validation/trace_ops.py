@@ -1,0 +1,164 @@
+"""Op-level device-time breakdown via the JAX profiler (VERDICT r2 #2/#3).
+
+Captures a real profiler trace of either the canonical adaptive
+megastep (--mode mega) or the uniform 8192^2 projection step
+(--mode uniform) on the attached chip, then parses the xplane protobuf
+with tensorboard_plugin_profile into per-op device totals — the
+trace-backed evidence the round-2 verdict demanded in place of the
+analytic flop/byte model.
+
+    python -m validation.trace_ops --mode uniform --size 8192
+    python -m validation.trace_ops --mode mega --levelmax 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import shutil
+import tempfile
+import time
+
+
+def _fence(x) -> float:
+    return float(x.reshape(-1)[0])
+
+
+def capture_uniform(size: int, trace_dir: str, reps: int):
+    import jax
+    import jax.numpy as jnp
+
+    from cup2d_tpu.cache import enable_compilation_cache
+    enable_compilation_cache()
+    from bench import bench_state  # repo-root bench helpers
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.uniform import UniformGrid
+
+    level = 0
+    cfg = SimConfig(bpdx=size // 8, bpdy=size // 8, level_max=1,
+                    level_start=0, extent=1.0, nu=1e-5, cfl=0.45,
+                    dtype="float32", poisson_tol=1e-3,
+                    poisson_tol_rel=1e-2, max_poisson_iterations=1000)
+    grid = UniformGrid(cfg, level=level)
+    state = bench_state(grid)
+    dt = jnp.asarray(1e-4, grid.dtype)
+    step = jax.jit(lambda s: grid.step(s, dt)[0])
+    # warm until the deltap initial guess coasts (bench.py's production
+    # regime: ~0.5 Poisson iterations/step) so the trace shows the
+    # steady-state composition, not a cold pressure solve
+    for _ in range(8):
+        state = step(state)
+    _fence(state.vel)
+    with jax.profiler.trace(trace_dir):
+        s = state
+        for _ in range(reps):
+            s = step(s)
+        _fence(s.vel)
+
+
+def capture_mega(levelmax: int, trace_dir: str, reps: int):
+    import jax
+    import jax.numpy as jnp
+
+    from cup2d_tpu.cache import enable_compilation_cache
+    enable_compilation_cache()
+    from validation.canonical import build_canonical_sim
+
+    sim = build_canonical_sim(levelmax=levelmax)
+    cfg = sim.cfg
+    sim.initialize()
+    for _ in range(30):
+        if sim.step_count <= 10 or sim.step_count % cfg.adapt_steps == 0:
+            sim.adapt()
+        sim.step_once()
+    sim._refresh()
+    ordf = sim._ordered_state()
+    inputs = sim._shape_inputs()
+    f = sim.forest
+    prescribed = jnp.asarray(
+        [[s.u, s.v, s.omega] for s in sim.shapes], dtype=f.dtype)
+    dt = jnp.asarray(sim._next_dt or sim.compute_dt(), f.dtype)
+    hmin = jnp.asarray(cfg.h_at(int(f.level[sim._order].max())), f.dtype)
+
+    def mega(vel, pres):
+        return sim._mega_jit(
+            vel, pres, inputs, prescribed, dt, hmin,
+            sim._h, sim._hsq_flat, sim._maskv, sim._xc, sim._yc,
+            sim._tables["vec3"], sim._tables["vec1"],
+            sim._tables["sca1"], sim._tables["pois"],
+            sim._tables.get("vec4t"), sim._tables.get("sca4t"),
+            sim._corr, exact_poisson=False, with_forces=False)
+
+    v, p = ordf["vel"], ordf["pres"]
+    out = mega(v, p)
+    _fence(out[0])
+    with jax.profiler.trace(trace_dir):
+        for _ in range(reps):
+            v, p, _, scal, _ = mega(v, p)
+        _fence(v)
+    print(json.dumps({"n_blocks": len(sim.forest.blocks),
+                      "n_pad": int(sim._npad_hwm)}))
+
+
+def parse_trace(trace_dir: str, reps: int, top: int = 40):
+    """Per-op device totals straight from the xplane protobuf (the
+    tensorboard_plugin_profile converter in this image predates its TF
+    pywrap API, so walk planes/lines/events directly)."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.xplane.pb"))
+    assert paths, f"no xplane under {trace_dir}"
+    xs = xplane_pb2.XSpace()
+    xs.ParseFromString(open(paths[0], "rb").read())
+    plane = next(p for p in xs.planes if p.name.startswith("/device:"))
+    em = plane.event_metadata
+    mod_ps = 0
+    agg: dict = {}
+    for line in plane.lines:
+        for ev in line.events:
+            name = em[ev.metadata_id].name
+            if line.name == "XLA Modules":
+                mod_ps += ev.duration_ps
+                continue
+            if line.name not in ("XLA Ops", "Async XLA Ops"):
+                continue
+            # strip the %op.NN id so occurrences aggregate by kind+shape
+            label = name.split(" = ", 1)[-1][:100]
+            d = agg.setdefault(label, [0, 0])
+            d[0] += ev.duration_ps
+            d[1] += 1
+    print(f"device module time: {mod_ps/1e9:.2f} ms over {reps} reps "
+          f"=> {mod_ps/1e9/reps:.3f} ms/rep")
+    for label, (ps, occ) in sorted(
+            agg.items(), key=lambda kv: -kv[1][0])[:top]:
+        print(f"{ps/1e9/reps:9.3f} ms/rep  x{occ:<6d} {label}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("uniform", "mega"), required=True)
+    ap.add_argument("--size", type=int, default=8192)
+    ap.add_argument("--levelmax", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--trace-dir", default=None)
+    ap.add_argument("--parse-only", default=None)
+    args = ap.parse_args()
+    if args.parse_only:
+        parse_trace(args.parse_only, args.reps)
+        return
+    trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="cup2d_trace_")
+    t0 = time.perf_counter()
+    if args.mode == "uniform":
+        capture_uniform(args.size, trace_dir, args.reps)
+    else:
+        capture_mega(args.levelmax, trace_dir, args.reps)
+    print(f"captured in {time.perf_counter()-t0:.1f} s -> {trace_dir}")
+    parse_trace(trace_dir, args.reps)
+
+
+if __name__ == "__main__":
+    main()
