@@ -153,9 +153,10 @@ class ShardedAMRSim(AMRSim):
         return self._shard_blocks(v)
 
     def _pressure_project(self, v, pres, dt, h, hsq,
-                          t1v, t1s, tpois, corr, exact_poisson, maskv,
+                          t1v, t1s, tpois, corr, tcoarse,
+                          exact_poisson, maskv,
                           chi=None, udef_b=None):
         v = self._shard_blocks(v)
         return super()._pressure_project(
-            v, pres, dt, h, hsq, t1v, t1s, tpois, corr,
+            v, pres, dt, h, hsq, t1v, t1s, tpois, corr, tcoarse,
             exact_poisson, maskv, chi=chi, udef_b=udef_b)

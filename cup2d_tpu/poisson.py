@@ -166,11 +166,38 @@ class MultigridPreconditioner:
         return self._smooth(e, r, lvl, self.nu2)
 
 
+def coarse_neumann_solve(rc: jnp.ndarray, h2) -> jnp.ndarray:
+    """Exact solve of the UNDIVIDED 5-point Neumann Laplacian on a small
+    uniform grid, L e = rc, returning e * h2 (the divided-operator
+    solution for spacing h = sqrt(h2)); the nullspace (constant mode) is
+    projected out. Used as the coarse half of the exact-mode two-level
+    preconditioner (VERDICT r2 #6): block-Jacobi alone leaves the global
+    pressure modes to the Krylov iteration, which is exactly why cold
+    startup solves burned hundreds of iterations.
+
+    Mechanism: mirror (even) extension to a 2x grid turns the Neumann
+    problem into a periodic one solved by FFT diagonalization — no
+    precomputed factorization, works at any size, MXU/FFT-friendly.
+    """
+    ncy, ncx = rc.shape
+    top = jnp.concatenate([rc, rc[:, ::-1]], axis=1)
+    ext = jnp.concatenate([top, top[::-1, :]], axis=0)
+    F = jnp.fft.rfft2(ext)
+    ky = 2.0 * jnp.cos(jnp.pi * jnp.arange(2 * ncy) / ncy) - 2.0
+    kx = 2.0 * jnp.cos(jnp.pi * jnp.arange(ncx + 1) / ncx) - 2.0
+    lam = ky[:, None] + kx[None, :]
+    E = jnp.where(lam < -1e-12, F / jnp.where(lam < -1e-12, lam, 1.0),
+                  0.0)
+    e = jnp.fft.irfft2(E, s=(2 * ncy, 2 * ncx))[:ncy, :ncx]
+    return (h2 * e).astype(rc.dtype)
+
+
 class BiCGSTABResult(NamedTuple):
     x: jnp.ndarray
     iters: jnp.ndarray
     residual: jnp.ndarray   # Linf of best residual seen
     converged: jnp.ndarray
+    stalled: jnp.ndarray    # exited via the L2 stall detector
 
 
 class _State(NamedTuple):
@@ -358,9 +385,11 @@ def bicgstab(
     # x_opt still holds an older iterate — return whichever is better
     final_norm = jnp.max(jnp.abs(final.r))
     use_x = final_norm <= final.norm_opt
+    converged = jnp.minimum(final_norm, final.norm_opt) <= target
     return BiCGSTABResult(
         x=jnp.where(use_x, final.x, final.x_opt),
         iters=final.it,
         residual=jnp.where(use_x, final_norm, final.norm_opt),
-        converged=jnp.minimum(final_norm, final.norm_opt) <= target,
+        converged=converged,
+        stalled=~converged & ((final.it - final.impr_it) >= stall_iters),
     )

@@ -1,0 +1,97 @@
+"""Scale-proof: drive the canonical case into the >=1e4-active-block
+regime and record per-phase costs (VERDICT r2 #4).
+
+The fully developed run.sh case lives at 1e4-1e5 blocks (SURVEY §6);
+round 2 only ever measured ~500. Wakes take hours of simulated time to
+develop that much resolution demand, so this probe reaches the regime
+the honest-but-fast way: the same two-fish levelMax-8 case with an
+aggressive refinement threshold (-Rtol override), which exercises the
+exact machinery that scales with block count — halo-table rebuild,
+regrid commit, pad-bucket growth, megastep at large n_pad — on the real
+chip. Prints one JSON line per sampled step plus a final summary.
+
+    python -m validation.scale_proof [--target 10000] [--rtol 0.05]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--target", type=int, default=10000)
+    ap.add_argument("--rtol", type=float, default=0.05)
+    ap.add_argument("--ctol", type=float, default=None)
+    ap.add_argument("--max-steps", type=int, default=400)
+    ap.add_argument("--levelmax", type=int, default=8)
+    args = ap.parse_args()
+
+    from cup2d_tpu.cache import enable_compilation_cache
+    enable_compilation_cache()
+    from cup2d_tpu.profiling import PhaseTimers
+
+    from validation.canonical import build_canonical_sim
+
+    ctol = args.ctol if args.ctol is not None else args.rtol / 5.0
+    sim = build_canonical_sim(levelmax=args.levelmax, rtol=args.rtol,
+                              ctol=ctol)
+    sim.timers = PhaseTimers()
+    t0 = time.perf_counter()
+    sim.initialize()
+    print(json.dumps({"phase": "init", "wall_s": round(
+        time.perf_counter() - t0, 1),
+        "n_blocks": len(sim.forest.blocks)}), flush=True)
+
+    step_walls, regrid_walls, table_walls = [], [], []
+    nb_hist = []
+    while (sim.step_count < args.max_steps
+           and len(sim.forest.blocks) < args.target):
+        if sim.step_count <= 10 or \
+                sim.step_count % sim.cfg.adapt_steps == 0:
+            t1 = time.perf_counter()
+            sim.adapt()
+            t2 = time.perf_counter()
+            # table rebuild happens inside the NEXT _refresh; time it
+            sim._refresh()
+            t3 = time.perf_counter()
+            regrid_walls.append(t2 - t1)
+            table_walls.append(t3 - t2)
+        t1 = time.perf_counter()
+        sim.step_once()
+        step_walls.append(time.perf_counter() - t1)
+        nb_hist.append(len(sim.forest.blocks))
+        if sim.step_count % 20 == 0:
+            print(json.dumps({
+                "step": sim.step_count, "t": round(sim.time, 4),
+                "n_blocks": nb_hist[-1], "n_pad": int(sim._npad_hwm),
+                "step_ms_median_last20": round(
+                    float(np.median(step_walls[-20:]) * 1e3), 1),
+            }), flush=True)
+
+    w = np.asarray(step_walls[5:] or step_walls or [0.0])
+    print(json.dumps({
+        "phase": "summary",
+        "final_blocks": len(sim.forest.blocks),
+        "final_pad": int(sim._npad_hwm),
+        "steps": sim.step_count,
+        "step_ms_median": round(float(np.median(w) * 1e3), 1),
+        "step_ms_p90": round(float(np.percentile(w, 90) * 1e3), 1),
+        "regrid_s_median": round(
+            float(np.median(regrid_walls)), 2) if regrid_walls else None,
+        "regrid_s_max": round(
+            float(np.max(regrid_walls)), 2) if regrid_walls else None,
+        "tables_s_median": round(
+            float(np.median(table_walls)), 2) if table_walls else None,
+        "tables_s_max": round(
+            float(np.max(table_walls)), 2) if table_walls else None,
+        "timers": sim.timers.summary() if sim.timers else None,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
