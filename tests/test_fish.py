@@ -96,3 +96,36 @@ def test_surface_polygon_closed_and_transformed():
     # 90 deg: fish extends along +y from its center, stays near x=1
     assert np.ptp(poly[:, 1]) > np.ptp(poly[:, 0])
     assert abs(np.mean(poly[:, 0]) - 1.0) < 0.05
+
+
+def test_kinematic_dt_cap_bounds_gait_advance():
+    """The gait-period dt cap (shapes_host._kinematic_dt_cap, a
+    deliberate deviation from the reference's pure CFL control,
+    main.cpp:6579-6595): on a coarse quiescent grid the CFL/diffusive
+    dt exceeds the swimming period — one step would advance the midline
+    by O(period), which is kinematic nonsense (the body teleports
+    through a full gait cycle between two penalization solves). The cap
+    must (a) actually bind in that regime at 1/20 of the fastest
+    period, (b) stay out of the way for rigid shapes."""
+    from cup2d_tpu.amr import AMRSim
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.models import DiskShape, FishShape
+
+    cfg = SimConfig(bpdx=2, bpdy=1, level_max=3, level_start=1,
+                    extent=1.0, dtype="float64", nu=4e-5, lam=1e6,
+                    rtol=2.0, ctol=1.0)
+    fish = FishShape(0.12, 0.55, 0.25, 0.0, cfg.min_h, period=0.8)
+    sim = AMRSim(cfg, shapes=[fish])
+    sim.compute_forces_every = 0
+    sim.initialize()
+    # quiescent flow: umax ~ 0 -> uncapped CFL dt is huge
+    uncapped = sim.compute_dt()
+    cap = sim._kinematic_dt_cap()
+    assert cap == 0.05 * 0.8
+    assert uncapped > cap, (uncapped, cap)
+    sim.step_once()
+    # the step really advanced by the cap, not the CFL dt
+    assert abs(sim.time - cap) < 1e-12, sim.time
+
+    rigid = AMRSim(cfg, shapes=[DiskShape(0.08, 0.55, 0.25)])
+    assert rigid._kinematic_dt_cap() == float("inf")
