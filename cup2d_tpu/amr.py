@@ -172,6 +172,7 @@ class AMRSim(ShapeHostMixin):
         self._next_dt = None
         self._next_dt_version = -1
         self._next_umax = None   # survives regrids (see step_once)
+        self._next_umax_version = -1
         self._raster_jit = jax.jit(self._rasterize_impl)
         self._vorticity_jit = jax.jit(self._vorticity_impl)
         self._tags_jit = jax.jit(self._tags_impl)
@@ -399,6 +400,13 @@ class AMRSim(ShapeHostMixin):
                 "slot fields were written while the ordered working "
                 "state held newer data; call sync_fields() before "
                 "writing forest.fields")
+        if self._ord_key is not None and self._ord_key[0] == f.version:
+            # same topology but the fields dict was rewritten
+            # externally (wver moved): the cached end-state umax/dt
+            # describe the overwritten field — drop them (a regrid, by
+            # contrast, keeps them for the 1.05-guarded branch)
+            self._next_dt = None
+            self._next_umax = None
         self._ord = {name: self._put_ordered(fld[self._order_j])
                      for name, fld in f.fields.items()}
         self._ord_key = key
@@ -1085,14 +1093,20 @@ class AMRSim(ShapeHostMixin):
         trajectory the checkpoint machinery promises to preserve."""
         return dt_from_umax(umax, hmin, self.cfg.nu, self.cfg.cfl)
 
+    def _hmin(self):
+        """Finest active spacing as a device scalar — the ONE
+        definition every dt path (compute_dt, both cached-umax branches,
+        the megastep argument) must share, or the restart/lockstep
+        contracts silently desynchronize."""
+        return jnp.asarray(
+            self.cfg.h_at(int(self.forest.level[self._order].max())),
+            self.forest.dtype)
+
     def compute_dt(self) -> float:
-        f = self.forest
         # masked: ordered pad rows carry stale (finite) data
         umax = jnp.max(jnp.abs(
             self._ordered_state()["vel"]) * self._maskv)
-        hmin = jnp.asarray(
-            self.cfg.h_at(int(f.level[self._order].max())), f.dtype)
-        return float(self._dt_from_umax(umax, hmin))
+        return float(self._dt_from_umax(umax, self._hmin()))
 
     def step_once(self, dt: Optional[float] = None):
         self._refresh()
@@ -1101,8 +1115,24 @@ class AMRSim(ShapeHostMixin):
             tm = self.timers or NULL_TIMERS
             ordf = self._ordered_state()
             if dt is None:
+                # same cached-umax policy as the obstacle path: the
+                # previous step's end-state umax (kept ON DEVICE) feeds
+                # the shared dt arithmetic — one scalar round trip
+                # instead of a full field reduction per step (the
+                # obstacle-free driver paid 2.3 s/step for compute_dt
+                # at 16k-pad through the tunnel, measured in the
+                # round-3 scale proof)
                 with tm.phase("dt"):
-                    dt = self.compute_dt()
+                    if self._next_umax is not None:
+                        # post-regrid: same 1.05 prolongation-overshoot
+                        # guard as the obstacle path (ADVICE r2)
+                        fac = (1.0 if self._next_umax_version
+                               == f.version else 1.05)
+                        dt = float(self._dt_from_umax(
+                            fac * jnp.asarray(self._next_umax, f.dtype),
+                            self._hmin()))
+                    else:
+                        dt = self.compute_dt()
             exact = self.step_count < 10
             with tm.phase("flow"):
                 vel, pres, diag = self._step_jit(
@@ -1114,6 +1144,11 @@ class AMRSim(ShapeHostMixin):
                     self._corr, self._coarse_cw if exact else None,
                     exact_poisson=exact)
                 self._set_ordered(vel=vel, pres=pres)
+                # end-state umax stays a DEVICE scalar — the next
+                # step's dt derives from it without an extra field
+                # reduction, and only its one-scalar pull touches host
+                self._next_umax = diag["umax"]
+                self._next_umax_version = f.version
                 if self.timers is not None:
                     jax.block_until_ready(vel)  # charge flow to "flow"
             self.time += dt
@@ -1144,12 +1179,9 @@ class AMRSim(ShapeHostMixin):
                 # bound (ADVICE r2): any overshoot up to 5% now tightens
                 # dt instead of silently stretching CFL.
                 with tm.phase("dt"):
-                    hmin = jnp.asarray(
-                        self.cfg.h_at(int(f.level[self._order].max())),
-                        f.dtype)
                     dt = min(float(self._dt_from_umax(
                         jnp.asarray(1.05 * self._next_umax, f.dtype),
-                        hmin)),
+                        self._hmin())),
                         self._kinematic_dt_cap())
             else:
                 with tm.phase("dt"):
@@ -1170,8 +1202,7 @@ class AMRSim(ShapeHostMixin):
         with_forces = bool(
             self.compute_forces_every
             and self.step_count % self.compute_forces_every == 0)
-        hmin = jnp.asarray(
-            cfg.h_at(int(f.level[self._order].max())), f.dtype)
+        hmin = self._hmin()
         ordf = self._ordered_state()
         with tm.phase("flow"):
             vel, pres, chi_new, scalars, forces = self._mega_jit(
@@ -1197,6 +1228,7 @@ class AMRSim(ShapeHostMixin):
         self._next_dt = float(dt_next)
         self._next_dt_version = f.version
         self._next_umax = float(diag["umax"])
+        self._next_umax_version = f.version
         if with_forces:
             with tm.phase("forces"):
                 self._record_forces(forces)

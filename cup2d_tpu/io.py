@@ -172,6 +172,26 @@ def save_checkpoint(dirpath: str, sim) -> None:
         "config": {k: v for k, v in vars(sim.cfg).items()
                    if not k.startswith("_")},
     }
+    if hasattr(sim, "forest") and hasattr(sim, "_next_dt"):
+        # the cached next-dt state must SURVIVE the checkpoint, or a
+        # restart right after a regrid takes compute_dt's post-regrid
+        # umax while the uninterrupted run takes 1.05x the cached
+        # pre-regrid umax — a dt fork the bit-exact-resume contract
+        # forbids. 'current' records whether each cache matched the
+        # topology at save time (version counters don't survive a
+        # rebuild, the boolean does).
+        fver = sim.forest.version
+        meta["dt_cache"] = {
+            "next_dt": sim._next_dt,
+            "next_dt_current": bool(
+                sim._next_dt is not None
+                and sim._next_dt_version == fver),
+            "next_umax": (float(sim._next_umax)
+                          if sim._next_umax is not None else None),
+            "next_umax_current": bool(
+                sim._next_umax is not None
+                and getattr(sim, "_next_umax_version", -1) == fver),
+        }
     with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
     # swap order matters for crash safety: park the old checkpoint aside,
@@ -223,13 +243,24 @@ def load_checkpoint(dirpath: str, sim) -> None:
         meta = json.load(f)
     sim.time = float(meta["time"])
     sim.step_count = int(meta["step_count"])
-    # cached next-dt state belongs to the ABANDONED trajectory: a stale
-    # umax/dt surviving the restore would fork the restart from the
-    # uninterrupted run (the bit-exact-resume contract, tests/test_io)
+    # restore the cached next-dt state (or clear it for checkpoints
+    # predating dt_cache): the restart must take the SAME dt branch as
+    # the uninterrupted run (see save_checkpoint)
     for attr, cleared in (("_next_dt", None), ("_next_umax", None),
-                          ("_next_dt_version", -1)):
+                          ("_next_dt_version", -1),
+                          ("_next_umax_version", -1)):
         if hasattr(sim, attr):
             setattr(sim, attr, cleared)
+    dtc = meta.get("dt_cache")
+    if dtc and hasattr(sim, "forest") and hasattr(sim, "_next_dt"):
+        fver = sim.forest.version
+        if dtc.get("next_dt") is not None:
+            sim._next_dt = float(dtc["next_dt"])
+            sim._next_dt_version = fver if dtc["next_dt_current"] else -1
+        if dtc.get("next_umax") is not None:
+            sim._next_umax = float(dtc["next_umax"])
+            sim._next_umax_version = (
+                fver if dtc["next_umax_current"] else -1)
     shapes_path = os.path.join(dirpath, "shapes.pkl")
     if hasattr(sim, "shapes") and os.path.exists(shapes_path):
         with open(shapes_path, "rb") as f:

@@ -135,19 +135,27 @@ def test_amr_checkpoint_roundtrip(tmp_path):
     b = np.asarray(sim2.forest.fields["vel"][sim2.forest.order()])
     assert np.abs(a - b).max() < 1e-12
 
-    # and WITHOUT an explicit dt: a FRESH restart (no cached next-dt)
-    # takes the compute_dt fallback while the uninterrupted run uses
-    # the device-cached value — the shared dt_from_umax arithmetic must
-    # keep times in lockstep
+    # and WITHOUT an explicit dt: the checkpoint persists the cached
+    # next-dt state (a restart must take the SAME dt branch as the
+    # uninterrupted run — a post-regrid restart would otherwise fork),
+    # and a cache-cleared restart exercises the compute_dt fallback,
+    # whose shared dt_from_umax arithmetic must keep times in lockstep
     path2 = str(tmp_path / "ckpt2")
     save_checkpoint(path2, sim)
     sim3 = AMRSim(cfg, shapes=[DiskShape(0.08, 0.55, 0.25)])
     sim3.compute_forces_every = 0
     load_checkpoint(path2, sim3)
-    assert sim3._next_dt is None       # the fallback really runs
+    assert sim3._next_dt == sim._next_dt      # cache restored
+    sim4 = AMRSim(cfg, shapes=[DiskShape(0.08, 0.55, 0.25)])
+    sim4.compute_forces_every = 0
+    load_checkpoint(path2, sim4)
+    sim4._next_dt = None                       # force the fallback
+    sim4._next_umax = None
     sim.step_once()                    # cached-dt path
-    sim3.step_once()                   # compute_dt fallback path
-    assert sim.time == sim3.time, (sim.time, sim3.time)
+    sim3.step_once()                   # restored-cache path
+    sim4.step_once()                   # compute_dt fallback path
+    assert sim.time == sim3.time == sim4.time, (
+        sim.time, sim3.time, sim4.time)
 
 
 def test_cli_amr_smoke(tmp_path):

@@ -8,6 +8,7 @@ sustain a stream, so towing is the Galilean twin of flow past a fixed
 body, exactly like the reference's self-propelled fish.
 
     python -m validation.cylinder drag      # Re=40 steady drag, ~10 min
+    python -m validation.cylinder dragwide  # same at half blockage
     python -m validation.cylinder strouhal  # Re=200 shedding, ~30 min
 
 Published references: Cd(Re=40) ~ 1.5-1.6 unbounded (Tritton 1959);
@@ -24,7 +25,7 @@ import time
 import numpy as np
 
 
-def _build(D, U, nu, level, xpos, forces_every):
+def _build(D, U, nu, level, xpos, forces_every, bpdy=1):
     import jax.numpy as jnp  # noqa: F401  (jax init before sim build)
 
     from cup2d_tpu.cache import enable_compilation_cache
@@ -33,12 +34,13 @@ def _build(D, U, nu, level, xpos, forces_every):
     from cup2d_tpu.sim import Simulation
 
     enable_compilation_cache()
-    cfg = SimConfig(bpdx=4, bpdy=1, level_max=1, level_start=0,
+    cfg = SimConfig(bpdx=4, bpdy=bpdy, level_max=1, level_start=0,
                     extent=4.0, dtype="float32", nu=nu, lam=1e6, cfl=0.5,
                     max_poisson_iterations=200, poisson_tol=1e-3,
                     poisson_tol_rel=1e-2)
     sim = Simulation(
-        cfg, shapes=[DiskShape(D / 2, xpos, 0.5, prescribed=(-U, 0.0))],
+        cfg, shapes=[DiskShape(D / 2, xpos, 0.5 * bpdy,
+                               prescribed=(-U, 0.0))],
         level=level)
     sim.compute_forces_every = forces_every
     sim.force_log = io.StringIO()
@@ -51,11 +53,15 @@ def _force_table(sim):
     return np.array([[float(c) for c in row.split(",")] for row in rows])
 
 
-def drag():
+def drag(bpdy=1):
     """Re = 40: steady drag coefficient from the surface-traction
-    diagnostics, averaged over the quasi-steady window."""
+    diagnostics, averaged over the quasi-steady window. ``bpdy=2``
+    doubles the transverse extent (blockage 10% -> 5%) — the domain-size
+    study that pins the blockage correction the round-2 Cd leaned on
+    (VERDICT r2 weak #7)."""
     D, U, nu = 0.1, 0.2, 5e-4
-    sim = _build(D, U, nu, level=5, xpos=3.2, forces_every=5)  # 1024x256
+    sim = _build(D, U, nu, level=5, xpos=3.2, forces_every=5,
+                 bpdy=bpdy)  # 1024 x 256*bpdy
     t0 = time.perf_counter()
     while sim.time < 6.0 and sim.shapes[0].com[0] > 0.5:
         sim.step_once()
@@ -105,6 +111,8 @@ def main(argv=None) -> int:
     which = args[0] if args else "drag"
     if which == "drag":
         drag()
+    elif which == "dragwide":
+        drag(bpdy=2)
     elif which == "strouhal":
         strouhal()
     else:

@@ -80,7 +80,7 @@ def main():
             sim._tables["vec3"], sim._tables["vec1"],
             sim._tables["sca1"], sim._tables["pois"],
             sim._tables.get("vec4t"), sim._tables.get("sca4t"),
-            sim._corr, exact_poisson=False, with_forces=False)
+            sim._corr, None, exact_poisson=False, with_forces=False)
 
     vel, pres = ordf["vel"], ordf["pres"]
     out = mega(vel, pres)          # compile/warm this exact signature

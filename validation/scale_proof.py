@@ -13,8 +13,8 @@ round 2 only ever measured ~500. Two modes:
   mode that produced the BASELINE.md 1e4-regime table; the machinery
   whose scaling is in question (halo-table rebuild, regrid commit,
   pad-bucket growth, step at 16k-pad) doesn't care where blocks came
-  from. Compression is disabled and --ctol/--target are ignored (the
-  run holds the regime for --max-steps).
+  from. Compression is disabled there: --ctol is rejected, --target
+  is ignored (the run holds the regime for --max-steps).
 
 Prints one JSON line per sampled step plus a final summary.
 
