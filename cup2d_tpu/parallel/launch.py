@@ -20,6 +20,33 @@ coordinator/process_id from the pod metadata), then `global_mesh()`
 returns the mesh over every chip of every host; `ShardedUniformSim`
 / `ShardedAMRSim` take it unchanged. Single-host runs (and the CPU
 virtual-device CI mesh) skip initialize and get the local mesh.
+
+Multi-host AMR determinism (the reference's update_boundary /
+update_blocks contract, main.cpp:1410-1970): the host-side regrid
+bookkeeping — tag thresholding, 2:1 state fixing, slot allocation, SFC
+ordering, gather-table builds — runs INDEPENDENTLY on every process,
+and the SPMD program diverges (hangs or corrupts) if any process
+reaches a different decision. The design makes that impossible by
+construction:
+
+1. every regrid decision derives from ONE tag vector that every
+   process holds in full — `AMRSim._pull_blockwise` turns the
+   device-side tag pull into a `process_allgather` when
+   `jax.process_count() > 1` (single global collective, then identical
+   host numpy on every process);
+2. everything downstream of the tags is deterministic pure-python/numpy
+   on identical inputs (no hash-order iteration on data that differs
+   per process: the state machine iterates SFC-sorted arrays);
+3. scalar diagnostics (dt, umax, residuals) are outputs of global
+   reductions — fully replicated across processes by SPMD semantics,
+   so plain device_get agrees everywhere.
+
+`tests/test_multihost.py` enforces this with two real jax.distributed
+processes: three regrid+step cycles must produce identical topology +
+gather-table digests on both. Known multi-host gaps (single-host-only
+conveniences, not correctness hazards): dumps/checkpoints np.asarray
+fully-sharded fields and therefore need a process-0 gather step on a
+real pod.
 """
 
 from __future__ import annotations

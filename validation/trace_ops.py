@@ -15,10 +15,8 @@ from __future__ import annotations
 
 import argparse
 import glob
-import gzip
 import json
 import os
-import shutil
 import tempfile
 import time
 
