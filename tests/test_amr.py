@@ -373,3 +373,44 @@ def test_initialize_coarse_start_matches_levelstart_grid():
             if not s.adapt():
                 break
     assert set(a.forest.blocks) == set(b.forest.blocks)
+
+
+def test_external_field_write_invalidates_cached_dt():
+    """Writing forest.fields mid-run (the established seeding pattern,
+    applied between steps) must drop the cached end-state umax the next
+    dt derives from, alongside the ordered-state cache — a stale umax
+    would run the stronger new field at an overlarge dt (a silent CFL
+    violation)."""
+    from cup2d_tpu.ops.stencil import dt_from_umax
+
+    cfg = SimConfig(bpdx=2, bpdy=2, level_max=3, level_start=1,
+                    extent=1.0, dtype="float64", nu=1e-3,
+                    rtol=1e9, ctol=-1.0)
+    sim = AMRSim(cfg)
+    f = sim.forest
+    _fill_tg(sim)
+    sim.step_once(dt=1e-3)
+    sim.step_once()                      # populates the umax cache
+    assert sim._next_umax is not None
+    umax_old = float(jnp.asarray(sim._next_umax))
+
+    # 10x stronger field written externally (slot layout, post-sync)
+    sim.sync_fields()
+    order = f.order()
+    vel = np.array(f.fields["vel"])   # copy: device views are read-only
+    vel[order] *= 10.0
+    f.fields["vel"] = jnp.asarray(vel)
+
+    t_before = sim.time
+    sim.step_once()                      # dt must derive from NEW field
+    dt_used = sim.time - t_before
+    hmin = float(sim._hmin())
+    dt_stale = float(dt_from_umax(
+        jnp.asarray(umax_old), jnp.asarray(hmin), cfg.nu, cfg.cfl))
+    dt_fresh = float(dt_from_umax(
+        jnp.asarray(10.0 * umax_old), jnp.asarray(hmin),
+        cfg.nu, cfg.cfl))
+    # the used dt matches the fresh-field CFL, not the stale cache
+    assert abs(dt_used - dt_fresh) < 1e-12 * dt_fresh, \
+        (dt_used, dt_fresh, dt_stale)
+    assert dt_used < 0.5 * dt_stale
