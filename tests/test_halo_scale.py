@@ -58,3 +58,22 @@ def test_build_tables_at_scale():
     n = len(order)
     assert int(np.max(np.asarray(t.dest_s))) < n * L * L
     assert int(np.min(np.asarray(t.dest_s))) >= 0
+
+
+def test_build_tables_at_1e4_blocks():
+    """The 1e4-block regime (SURVEY §6's fully developed canonical
+    case; measured on-chip in the round-3 scale proof at 0.39 s/build).
+    The template memo must keep WARM rebuilds — the steady-state
+    regrid path — in single-digit seconds at this size on a 1-core CI
+    host; a scaling regression to per-pattern rebuilds shows up as
+    minutes here."""
+    f = _adapted_forest(levels=4)
+    order = f.order()
+    assert len(order) >= 10000, f"forest too small: {len(order)}"
+    build_tables(f, order, 3, True, 2)      # cold: fills the memo
+    t0 = time.perf_counter()
+    t = build_tables(f, order, 3, True, 2)  # warm: the per-regrid cost
+    warm = time.perf_counter() - t0
+    assert warm < 10.0, f"warm rebuild too slow at 1e4: {warm:.1f}s"
+    n = len(order)
+    assert int(np.max(np.asarray(t.dest_s))) < n * t.L * t.L
