@@ -390,7 +390,9 @@ class AMRSim(ShapeHostMixin):
         f = self.forest
         self._refresh()
         key = (f.version, f.fields.wver)
-        if self._ord_key == key:
+        if self._ord_key == key and self._ord is not None:
+            # (_ord None with a matching key = checkpoint restore just
+            # re-anchored the wver trail; fall through and rebuild)
             return self._ord
         if self._ord_dirty:
             # a hard error (not an assert: must survive python -O) —
@@ -400,11 +402,16 @@ class AMRSim(ShapeHostMixin):
                 "slot fields were written while the ordered working "
                 "state held newer data; call sync_fields() before "
                 "writing forest.fields")
-        if self._ord_key is not None and self._ord_key[0] == f.version:
+        if self._ord_key is not None and self._ord_key[0] == f.version \
+                and self._ord_key != key:
             # same topology but the fields dict was rewritten
             # externally (wver moved): the cached end-state umax/dt
             # describe the overwritten field — drop them (a regrid, by
-            # contrast, keeps them for the 1.05-guarded branch)
+            # contrast, keeps them for the 1.05-guarded branch). The
+            # key-inequality guard matters: a checkpoint restore lands
+            # here with _ord=None and an UNmoved key, and must keep its
+            # restored dt cache (the restart takes the same dt branch
+            # as the uninterrupted run).
             self._next_dt = None
             self._next_umax = None
         self._ord = {name: self._put_ordered(fld[self._order_j])
@@ -430,6 +437,17 @@ class AMRSim(ShapeHostMixin):
                 x[:self._n_real])
         self._ord_key = (f.version, f.fields.wver)
         self._ord_dirty = False
+
+    def fields(self) -> dict:
+        """Slot-layout fields, guaranteed current.
+
+        The supported read path for external/analysis consumers: syncs
+        the ordered working state back into ``forest.fields`` first, so
+        a reader can never observe pre-step data (reading
+        ``forest.fields`` directly between steps silently returns the
+        state as of the last sync — ADVICE r3)."""
+        self.sync_fields()
+        return self.forest.fields
 
     def _set_ordered(self, **updates):
         """Adopt step outputs as the new ordered truth."""
@@ -1159,6 +1177,12 @@ class AMRSim(ShapeHostMixin):
             self.initialize()
             self._refresh()
         tm = self.timers or NULL_TIMERS
+        # run the external-write invalidation BEFORE the dt branch: an
+        # external forest.fields write between steps (wver moved) must
+        # drop the cached _next_dt/_next_umax here exactly as on the
+        # obstacle-free path, or one step runs at the stale dt — a
+        # silent CFL violation (ADVICE r3 medium)
+        self._ordered_state()
         if dt is None:
             # prefer the dt the PREVIOUS megastep computed on device —
             # a fresh compute_dt() is a full host<->device round trip

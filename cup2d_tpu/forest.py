@@ -39,13 +39,52 @@ class _FieldsDict(dict):
         super().__init__(*a, **k)
         self.wver = 0
 
+    # wver moves only when a mutation actually happens: a spurious bump
+    # either aborts the next _ordered_state() (when the ordered cache
+    # is dirty) or silently drops the cached end-state dt — so the
+    # no-op forms (setdefault on a present key, pop of a missing key
+    # with default, failed del) must NOT count as writes.
     def __setitem__(self, key, value):
         self.wver += 1
         super().__setitem__(key, value)
 
     def update(self, *a, **k):
-        self.wver += 1
+        # len() covers mappings and sequences; a bare iterator can't be
+        # emptiness-tested without consuming it, so it counts as a write
+        if k or (a and (not hasattr(a[0], "__len__") or len(a[0]))):
+            self.wver += 1
         super().update(*a, **k)
+
+    def __ior__(self, other):
+        # `fields |= {...}` does NOT route through update() in CPython
+        self.update(other)
+        return self
+
+    def __delitem__(self, key):
+        super().__delitem__(key)
+        self.wver += 1
+
+    def pop(self, key, *default):
+        existed = key in self
+        val = super().pop(key, *default)
+        if existed:
+            self.wver += 1
+        return val
+
+    def popitem(self):
+        item = super().popitem()
+        self.wver += 1
+        return item
+
+    def setdefault(self, key, default=None):
+        if key not in self:
+            self.wver += 1
+        return super().setdefault(key, default)
+
+    def clear(self):
+        if self:
+            self.wver += 1
+        super().clear()
 
 
 class Forest:

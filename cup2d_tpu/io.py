@@ -234,6 +234,27 @@ def load_checkpoint(dirpath: str, sim) -> None:
                 f.fields[name] = jnp.zeros(
                     (f.capacity,) + vals.shape[1:], f.dtype
                 ).at[jnp.asarray(slots)].set(vals)
+            if hasattr(sim, "_ord"):
+                # the restored slot fields are now the truth — discard
+                # the ordered-state cache outright. Leaving _ord_dirty
+                # set would make the next _ordered_state() raise, and
+                # its advice (sync_fields) would overwrite the freshly
+                # restored fields with pre-restore data (ADVICE r3).
+                # The key is re-anchored (not None-ed) at the restored
+                # (version, wver) so a field write BETWEEN restore and
+                # the first step still trips the wver-moved branch that
+                # drops the restored dt cache — _ordered_state()'s
+                # invalidation is guarded by _ord_key being non-None.
+                sim._ord = None
+                sim._ord_dirty = False
+                if hasattr(sim, "_refresh"):
+                    # refresh BEFORE anchoring: an exactly-full forest
+                    # makes the first _refresh_impl call _grow(), whose
+                    # field reassignments move wver — anchoring at the
+                    # pre-refresh wver would then spuriously drop the
+                    # restored dt cache below
+                    sim._refresh()
+                sim._ord_key = (f.version, f.fields.wver)
         else:
             sim.state = type(sim.state)(**{
                 k: jnp.asarray(data[k], dtype=sim.grid.dtype)

@@ -414,3 +414,50 @@ def test_external_field_write_invalidates_cached_dt():
     assert abs(dt_used - dt_fresh) < 1e-12 * dt_fresh, \
         (dt_used, dt_fresh, dt_stale)
     assert dt_used < 0.5 * dt_stale
+
+
+def test_external_field_write_invalidates_cached_dt_shaped():
+    """Same contract on the OBSTACLE path: its dt branch reads
+    _next_dt/_next_umax, so the external-write invalidation must run
+    BEFORE dt selection there too (ADVICE r3 medium — the megastep
+    otherwise executes one step at the stale dt)."""
+    from cup2d_tpu.models import DiskShape
+
+    cfg = SimConfig(bpdx=2, bpdy=1, level_max=3, level_start=2,
+                    extent=1.0, dtype="float64", nu=1e-3, lam=1e5,
+                    rtol=1e9, ctol=-1.0)
+    # prescribed tow speed so dt is CFL-(advection-)bound: a 10x field
+    # write then moves dt materially (a still fluid is diffusion-bound
+    # and dt barely notices umax)
+    sim = AMRSim(cfg, shapes=[DiskShape(0.08, 0.55, 0.25,
+                                        prescribed=(0.2, 0.0))])
+    sim.compute_forces_every = 0
+    sim.initialize()
+    sim.step_once()
+    sim.step_once()                      # populates _next_dt/_next_umax
+    assert sim._next_dt is not None
+    dt_stale = min(sim._next_dt, sim._kinematic_dt_cap())
+
+    # 10x stronger field written externally (slot layout, post-sync)
+    f = sim.forest
+    sim.sync_fields()
+    order = f.order()
+    vel = np.array(f.fields["vel"])
+    vel[order] *= 10.0
+    f.fields["vel"] = jnp.asarray(vel)
+
+    # expected dt from the new field WITHOUT calling sim.compute_dt()
+    # (that would itself run _ordered_state()'s invalidation and mask a
+    # missing fix in step_once)
+    from cup2d_tpu.ops.stencil import dt_from_umax
+    umax_new = float(np.abs(vel[order]).max())
+    dt_fresh = min(
+        float(dt_from_umax(jnp.asarray(umax_new), sim._hmin(),
+                           cfg.nu, cfg.cfl)),
+        sim._kinematic_dt_cap())
+    t_before = sim.time
+    sim.step_once()                      # dt must derive from NEW field
+    dt_used = sim.time - t_before
+    assert abs(dt_used - dt_fresh) < 1e-12 * dt_fresh, \
+        (dt_used, dt_fresh, dt_stale)
+    assert dt_used < 0.75 * dt_stale

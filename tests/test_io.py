@@ -164,3 +164,113 @@ def test_restore_clears_cached_dt_state():
         load_checkpoint(d + "/ck", sim)
         assert sim._next_umax is None
         assert sim._next_dt is None
+
+
+def test_restore_resets_ordered_cache():
+    """Restoring into a sim that stepped since its last sync_fields must
+    discard the ordered-state cache: with _ord_dirty left set the next
+    _ordered_state() raises, and following the error's advice
+    (sync_fields) would clobber the restored fields with pre-restore
+    data (ADVICE r3)."""
+    from cup2d_tpu.amr import AMRSim
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.io import load_checkpoint, save_checkpoint
+    from cup2d_tpu.models import DiskShape
+
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=3, level_start=1,
+                    extent=1.0, dtype="float64", nu=1e-3, lam=1e5,
+                    rtol=0.5, ctol=0.05, max_poisson_iterations=40,
+                    poisson_tol=1e-4, poisson_tol_rel=1e-3)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        sim = AMRSim(cfg, shapes=[DiskShape(0.08, 0.4, 0.5,
+                                            prescribed=(0.2, 0.0))])
+        sim.compute_forces_every = 0
+        sim.initialize()
+        sim.step_once()
+        sim.sync_fields()
+        ck = d + "/ck"
+        save_checkpoint(ck, sim)
+        # capture in SFC order: slot numbering does not survive restore
+        saved_vel = np.array(
+            sim.forest.fields["vel"][sim.forest.order()])
+        sim.step_once()          # ordered state now newer than slots
+        assert sim._ord_dirty
+        load_checkpoint(ck, sim)
+        # no RuntimeError, and the working state IS the checkpoint
+        ordf = sim._ordered_state()
+        n = len(sim.forest.order())
+        got = np.asarray(ordf["vel"])[:n]
+        assert np.array_equal(got, saved_vel)
+
+
+def test_field_write_after_restore_drops_restored_dt_cache():
+    """A forest.fields write in the restore->first-step window must
+    still drop the restored dt cache: load_checkpoint re-anchors (not
+    clears) the ordered-cache key precisely so the wver-moved
+    invalidation stays armed (code-review r4)."""
+    from cup2d_tpu.amr import AMRSim
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.io import load_checkpoint, save_checkpoint
+    from cup2d_tpu.models import DiskShape
+
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=3, level_start=1,
+                    extent=1.0, dtype="float64", nu=1e-3, lam=1e5,
+                    rtol=0.5, ctol=0.05, max_poisson_iterations=40,
+                    poisson_tol=1e-4, poisson_tol_rel=1e-3)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        sim = AMRSim(cfg, shapes=[DiskShape(0.08, 0.4, 0.5,
+                                            prescribed=(0.2, 0.0))])
+        sim.compute_forces_every = 0
+        sim.initialize()
+        sim.step_once()
+        sim.step_once()
+        save_checkpoint(d + "/ck", sim)
+        load_checkpoint(d + "/ck", sim)
+        assert sim._next_dt is not None          # restored as current
+        # no write: the first _ordered_state() must KEEP the restored
+        # cache (the restart takes the same dt branch as the
+        # uninterrupted run) — guards against the invalidation firing
+        # on the unmoved key
+        sim._ordered_state()
+        assert sim._next_dt is not None and sim._next_umax is not None
+        f = sim.forest
+        order = f.order()
+        vel = np.array(f.fields["vel"])
+        vel[order] *= 10.0
+        f.fields["vel"] = jnp.asarray(vel)       # wver moves
+        sim._ordered_state()
+        assert sim._next_dt is None and sim._next_umax is None
+
+
+def test_fields_dict_noop_calls_do_not_bump_wver():
+    """Non-mutating dict calls (setdefault on a present key, pop of a
+    missing key with a default) are reads: a spurious wver bump either
+    aborts the next _ordered_state() or silently drops the cached dt
+    (code-review r4)."""
+    from cup2d_tpu.forest import _FieldsDict
+
+    fd = _FieldsDict()
+    fd["a"] = 1
+    w = fd.wver
+    assert fd.setdefault("a", 2) == 1 and fd.wver == w
+    assert fd.pop("missing", None) is None and fd.wver == w
+    fd.update()
+    fd.update({})
+    fd.update([])
+    assert fd.wver == w
+    try:
+        del fd["missing"]
+    except KeyError:
+        pass
+    assert fd.wver == w
+    # real mutations still count
+    fd.setdefault("b", 3)
+    assert fd.wver == w + 1
+    fd.pop("b")
+    assert fd.wver == w + 2
+    fd.update({"c": 4})
+    assert fd.wver == w + 3
+    fd |= {"d": 5}                       # __ior__ bypasses update() in
+    assert fd.wver == w + 4 and fd["d"] == 5   # plain dict subclasses
