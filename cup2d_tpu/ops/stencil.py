@@ -18,6 +18,7 @@ are used where the reference uses them, so scalings match exactly.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -52,17 +53,64 @@ def shift(lab: jnp.ndarray, g: int, dy: int, dx: int) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 _WENO_EPS = 1e-6
+# guard for the fast-weights denominator: legitimate denominators stay
+# >= ~1e-21 (analysis in _weno5_weights); only total ratio-underflow
+# (b_max/b_min beyond ~1e19, i.e. an already-blown-up field) trips it
+_WENO_TINY = 1e-35
 
 
 def _weno5_weights(b1, b2, b3, g1, g2, g3):
-    # deliberately the textbook ratio form. The single-divide variant
-    # (n_i = g_i * prod_{j!=i} (b_j+e)^2, one normalization divide) is
-    # 17% faster on the STANDALONE advection op but does not move the
-    # fused full step at all (XLA hides the divides behind HBM traffic
-    # there), and its quartic products overflow f32 at b ~ 2e9 versus
-    # ~1e19 here — a transiently unstable run would NaN instead of
-    # producing large-but-finite values the CFL/penalization machinery
-    # can recover from. Measurements in BASELINE.md.
+    # max-normalized single-divide form (2 divides instead of the
+    # textbook 4): divide every smoothness indicator by the largest,
+    # so the ratios r_i live in (0, 1] and the cross products
+    # n_i = g_i * prod_{j != i} r_j^2 CANNOT overflow — which was the
+    # measured objection (f32 blow-up at b ~ 2e9) that kept round 2 on
+    # the ratio form. The weights are scale-invariant in b, so this is
+    # the same algebra, just evaluated at a safe scale. Advection is
+    # VPU-divide-bound at 8192^2 (10x above the HBM roofline,
+    # BASELINE.md r3 trace), so trading 2 divides for ~8 multiplies is
+    # the single biggest lever on the step.
+    #
+    # Degenerate tail: if b_max/b_min exceeds ~1e19 every cross
+    # product underflows to 0 (TPU flushes denormals); the 0/0 is
+    # caught by the _WENO_TINY select, which falls back to the optimal
+    # (central) weights — finite, convex, and irrelevant in practice
+    # because a field that rough has long since collapsed dt.
+    bmax = jnp.maximum(jnp.maximum(b1, b2), b3) + _WENO_EPS
+    if bmax.dtype == jnp.float32:
+        # the weights are EXACTLY scale-invariant in the normalizer
+        # (any common factor in the r_i cancels from n_i/den), so the
+        # 1/b_max divide needs no accuracy at all: a bit-trick
+        # approximate reciprocal (~2% error, one int subtract) replaces
+        # a VPU divide per reconstruction. f64 (CPU validation) keeps
+        # the exact divide — performance is irrelevant there and the
+        # magic constant is format-specific.
+        m = jax.lax.bitcast_convert_type(
+            jnp.int32(0x7EF311C3)
+            - jax.lax.bitcast_convert_type(bmax, jnp.int32),
+            jnp.float32)
+    else:
+        m = 1.0 / bmax
+    r1 = (b1 + _WENO_EPS) * m
+    r2 = (b2 + _WENO_EPS) * m
+    r3 = (b3 + _WENO_EPS) * m
+    s1, s2, s3 = r1 * r1, r2 * r2, r3 * r3
+    n1 = g1 * (s2 * s3)
+    n2 = g2 * (s1 * s3)
+    n3 = g3 * (s1 * s2)
+    den = (n1 + n3) + n2
+    ok = den > _WENO_TINY
+    aux = 1.0 / jnp.where(ok, den, 1.0)
+    w1 = jnp.where(ok, n1 * aux, g1)
+    w2 = jnp.where(ok, n2 * aux, g2)
+    w3 = jnp.where(ok, n3 * aux, g3)
+    return w1, w2, w3
+
+
+def _weno5_weights_ref(b1, b2, b3, g1, g2, g3):
+    # the textbook ratio form (bit-matches the reference's
+    # main.cpp:162-208 evaluation order) — kept for the bit-comparison
+    # tests that pin the fast form against it
     w1 = g1 / (b1 + _WENO_EPS) ** 2
     w2 = g2 / (b2 + _WENO_EPS) ** 2
     w3 = g3 / (b3 + _WENO_EPS) ** 2
@@ -99,10 +147,30 @@ def weno5_minus(um2, um1, u, up1, up2):
 
 def weno_derivative(wind, um3, um2, um1, u, up1, up2, up3):
     """Undivided upwind WENO5 derivative (main.cpp:202-208): flux difference
-    of the reconstruction chosen by the local wind sign."""
-    dplus = weno5_plus(um2, um1, u, up1, up2) - weno5_plus(um3, um2, um1, u, up1)
-    dminus = weno5_minus(um1, u, up1, up2, up3) - weno5_minus(um2, um1, u, up1, up2)
-    return jnp.where(wind > 0, dplus, dminus)
+    of the reconstruction chosen by the local wind sign.
+
+    Exploits the exact mirror identity
+    ``weno5_minus(a,b,c,d,e) == weno5_plus(e,d,c,b,a)`` (the smoothness
+    indicators, ideal weights and candidate stencils all pair up under
+    argument reversal, commutative adds only): selecting the five
+    STENCIL ARGUMENTS by wind sign up front needs 10 one-cycle selects
+    and TWO reconstructions, where the textbook both-branches-then-
+    select form needs FOUR. Bit-identical to the latter (asserted in
+    tests/test_fused_bc.py::test_weno_mirror_identity_bit_exact);
+    advection is the VPU-bound hot spot of the whole step, so halving
+    its reconstruction count is worth the obfuscation."""
+    pos = wind > 0
+
+    def sel(a, b):
+        return jnp.where(pos, a, b)
+
+    # wind>0: weno5_plus at i      | wind<0: weno5_minus at i (mirrored)
+    t1 = weno5_plus(sel(um2, up3), sel(um1, up2), sel(u, up1),
+                    sel(up1, u), sel(up2, um1))
+    # wind>0: weno5_plus at i-1    | wind<0: weno5_minus at i-1 (mirrored)
+    t2 = weno5_plus(sel(um3, up2), sel(um2, up1), sel(um1, u),
+                    sel(u, um1), sel(up1, um2))
+    return t1 - t2
 
 
 # ---------------------------------------------------------------------------
@@ -154,6 +222,115 @@ def advect_diffuse_core(vlab: jnp.ndarray, g: int, afac, dfac):
         - 4.0 * u
     )
     return afac * (wind_u * dx + wind_v * dy) + dfac * lap
+
+
+# ---------------------------------------------------------------------------
+# Fused-BC forms of the LINEAR operators (uniform path).
+#
+# jnp.pad(mode="edge") lowers to concatenates of edge strips that XLA
+# materializes (measured ~19 ms/step at 8192^2, the "halo-pad" slice of
+# the round-3 trace). For a linear stencil the physical BC is
+# equivalently a zero-ghost shift plus a rank-1 edge correction — the
+# Neumann ghost contributes the edge cell once per adjacent wall, the
+# free-slip ghost contributes +/- the edge cell — and zero padding is a
+# plain `pad` HLO that fuses into the consumer. These forms take the
+# UNPADDED field and produce bit-close (summation-order differs only in
+# wall cells) results to laplacian5(pad_scalar(p, 1)) etc.
+# ---------------------------------------------------------------------------
+
+def _edge_ones(n, dtype, lo=1.0, hi=1.0):
+    # iota + compares, NOT .at[].set on zeros: the latter bakes an HLO
+    # constant that is DMA-staged from HBM on every use inside loop
+    # bodies (~4.7 ms/step of f32[8192] copy-starts in the round-4
+    # trace); an iota is generated in-register for free
+    i = jnp.arange(n)
+    z = jnp.zeros((), dtype)
+    return jnp.where(i == 0, jnp.asarray(lo, dtype),
+                     jnp.where(i == n - 1, jnp.asarray(hi, dtype), z))
+
+
+def _zshift(p: jnp.ndarray, dy: int, dx: int,
+            spmd_safe: bool = False) -> jnp.ndarray:
+    """Shift with zero ghosts on an unpadded array (|dy|,|dx| <= 1).
+
+    Default form: pad(0)+slice — XLA folds the resulting negative-pad
+    into the consumer fusion (fastest single-device form, measured
+    3.8 vs 5.8 ms for the 8192^2 Laplacian against the edge-pad
+    original). This image's GSPMD partitioner MISCOMPILES that
+    negative-pad pattern when the sliced axis is sharded (compositions
+    return garbage at small shard widths — caught by the sharded-
+    equality test); ``spmd_safe=True`` switches to slice-then-pad,
+    which the partitioner handles exactly (to 1 ulp) at a ~2x cost the
+    sharded paths accept."""
+    ny, nx = p.shape[-2], p.shape[-1]
+    if spmd_safe:
+        ys = slice(max(dy, 0), ny + min(dy, 0))
+        xs = slice(max(dx, 0), nx + min(dx, 0))
+        q = p[..., ys, xs]
+        pad = [(0, 0)] * (p.ndim - 2) + [(max(-dy, 0), max(dy, 0)),
+                                         (max(-dx, 0), max(dx, 0))]
+        return jnp.pad(q, pad)
+    pad = [(0, 0)] * (p.ndim - 2) + [(max(-dy, 0), max(dy, 0)),
+                                     (max(-dx, 0), max(dx, 0))]
+    zp = jnp.pad(p, pad)
+    oy, ox = max(dy, 0), max(dx, 0)
+    return zp[..., oy:oy + ny, ox:ox + nx]
+
+
+def laplacian5_neumann(p: jnp.ndarray, spmd_safe: bool = False) -> jnp.ndarray:
+    """Undivided 5-point Laplacian with zero-Neumann walls, UNPADDED
+    input [..., Ny, Nx] — fused-BC equivalent of
+    ``laplacian5(pad_scalar(p, 1), 1)``."""
+    ny, nx = p.shape[-2], p.shape[-1]
+    ex = _edge_ones(nx, p.dtype)
+    ey = _edge_ones(ny, p.dtype)
+    zs = lambda dy, dx: _zshift(p, dy, dx, spmd_safe)
+    return (
+        zs(0, 1) + zs(0, -1) + zs(1, 0) + zs(-1, 0)
+        + p * ((ey[:, None] + ex[None, :]) - 4.0)
+    )
+
+
+def divergence_freeslip(v: jnp.ndarray, spmd_safe: bool = False) -> jnp.ndarray:
+    """Undivided central divergence with free-slip mirror walls,
+    UNPADDED input [..., 2, Ny, Nx] — fused-BC equivalent of
+    ``divergence(pad_vector(v, 1), 1)``. The mirrored normal component
+    (ghost = -edge) adds +u at the low wall and -u at the high wall."""
+    u = v[..., 0, :, :]
+    w = v[..., 1, :, :]
+    ny, nx = u.shape[-2], u.shape[-1]
+    gx = _edge_ones(nx, v.dtype, lo=1.0, hi=-1.0)
+    gy = _edge_ones(ny, v.dtype, lo=1.0, hi=-1.0)
+    return (
+        _zshift(u, 0, 1, spmd_safe) - _zshift(u, 0, -1, spmd_safe)
+        + u * gx[None, :]
+        + _zshift(w, 1, 0, spmd_safe) - _zshift(w, -1, 0, spmd_safe)
+        + w * gy[:, None]
+    )
+
+
+def divergence_rhs_fused(v, udef, chi, h, dt, spmd_safe: bool = False):
+    """Fused-BC pressure RHS: (h/2dt)[div(u*) - chi div(u_def)], all
+    inputs unpadded — replaces divergence_rhs(pad_vector(v,1), ...)."""
+    fac = 0.5 * h / dt
+    return (fac * divergence_freeslip(v, spmd_safe)
+            - (fac * chi) * divergence_freeslip(udef, spmd_safe))
+
+
+def pressure_gradient_update_fused(p: jnp.ndarray, h, dt,
+                                   spmd_safe: bool = False) -> jnp.ndarray:
+    """Fused-BC equivalent of
+    ``pressure_gradient_update(pad_scalar(p, 1), 1, h, dt)``: undivided
+    central gradient with Neumann ghosts (ghost = edge ⇒ the one-sided
+    difference p[1]-p[0] at the low wall, p[n-1]-p[n-2] at the high)."""
+    ny, nx = p.shape[-2], p.shape[-1]
+    gx = _edge_ones(nx, p.dtype, lo=-1.0, hi=1.0)
+    gy = _edge_ones(ny, p.dtype, lo=-1.0, hi=1.0)
+    pfac = -0.5 * dt * h
+    zs = lambda dy, dx: _zshift(p, dy, dx, spmd_safe)
+    dpx = (zs(0, 1) - zs(0, -1)) + p * gx[None, :]
+    dpy = (zs(1, 0) - zs(-1, 0)) + p * gy[:, None]
+    return pfac * jnp.stack([dpx, dpy], axis=-3)
 
 
 # ---------------------------------------------------------------------------

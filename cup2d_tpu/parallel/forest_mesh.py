@@ -88,9 +88,11 @@ class ShardedAMRSim(AMRSim):
         padded = {k: pad_tables(raw[k], n_pad)
                   for k in ("vec1t", "sca1t") if k in raw}
         out = dict(jax.device_put(padded, repl))
+        import os
+        mode = os.environ.get("CUP2D_SHARD_EXCHANGE", "ppermute")
         for k, t in raw.items():
             if k not in padded:
-                out[k] = shard_tables(t, n_pad, self.mesh)
+                out[k] = shard_tables(t, n_pad, self.mesh, mode=mode)
         return out
 
     def _finalize_corr(self, topo, n_pad):
@@ -98,9 +100,12 @@ class ShardedAMRSim(AMRSim):
         from .shard_halo import shard_flux_corr
         if n_pad % self.mesh.devices.size:
             return super()._finalize_corr(topo, n_pad)
+        import os
         raw = build_flux_corr(self.forest, self._order, topo=topo)
-        return shard_flux_corr(raw, n_pad, self.mesh, self.cfg.bs,
-                               dtype=np.dtype(self.forest.dtype))
+        return shard_flux_corr(
+            raw, n_pad, self.mesh, self.cfg.bs,
+            dtype=np.dtype(self.forest.dtype),
+            mode=os.environ.get("CUP2D_SHARD_EXCHANGE", "ppermute"))
 
     def _window_raster(self, inp, N):
         """Window rasterization with a shard-local scatter: every device

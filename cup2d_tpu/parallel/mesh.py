@@ -80,7 +80,10 @@ class ShardedUniformSim(UniformSim):
     """
 
     def __init__(self, cfg: SimConfig, mesh: Mesh, level: Optional[int] = None):
-        super().__init__(cfg, level)
+        # spmd_safe: the sharded axes go through the GSPMD partitioner,
+        # which miscompiles the fast pad+slice zero-shift form
+        # (ops/stencil._zshift)
+        super().__init__(cfg, level, spmd_safe=True)
         self.mesh = mesh
         if self.grid.nx % mesh.devices.size != 0:
             raise ValueError(
@@ -97,7 +100,8 @@ class ShardedUniformSim(UniformSim):
         self.state = shard_state(self.state, mesh)
         self._step = jax.jit(
             self.grid.step,
-            static_argnames=("exact_poisson",),
+            donate_argnums=(0,),
+            static_argnames=("exact_poisson", "obstacle_terms"),
             out_shardings=(state_shardings, None),
         )
 

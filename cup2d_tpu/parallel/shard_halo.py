@@ -48,12 +48,24 @@ class ShardTables(NamedTuple):
     mesh axis so each device reads only its own rows inside shard_map).
 
     Index spaces (per device d owning ordered blocks [dB, dB+B)):
-      gather sources: flat cells of [B own blocks ++ D*S surface blocks]
+      gather sources: flat cells of [B own blocks ++ received surface
+                      blocks (per-offset ppermute buckets, or the D*S
+                      all-gather buffer in mode="allgather")]
       scatter dests:  flat cells of [B labs] ++ 1 trailing scratch cell
                       (pad rows write zeros there; dropped on return)
+
+    Neighbor-wise exchange (default): ``offsets`` lists the nonzero
+    consumer-minus-owner shard distances that actually occur;
+    ``pack[o]`` holds, per owner device, the own-block indices to send
+    to owner+offsets[o] (one ``lax.ppermute`` per offset). Per-device
+    traffic is sum_o S_o — the device's own shard boundary — instead of
+    the all-gather's D*S_max (the GLOBAL boundary), restoring the
+    reference's per-neighbor-send scaling law (main.cpp:1971-2142).
+    SFC-contiguous shards keep the offset set small (almost always
+    {-1, +1}).
     """
 
-    pack: jnp.ndarray     # [D, S] int32 own-block indices to export
+    pack: jnp.ndarray     # [D, n_off, S] int32 own blocks to export
     src: jnp.ndarray      # [D, Gs] int32
     sign: jnp.ndarray     # [D, Gs, dim]
     dest_s: jnp.ndarray   # [D, Gs] int32
@@ -62,10 +74,12 @@ class ShardTables(NamedTuple):
     w: jnp.ndarray        # [D, Gg, K, dim]
     mesh: Mesh
     B: int                # blocks per device
-    S: int                # surface bucket
+    S: int                # surface bucket (mode-dependent semantics)
     L: int
     g: int
     dim: int
+    offsets: tuple        # static nonzero shard offsets (ppermute mode)
+    mode: str             # "ppermute" | "allgather"
 
     def assemble(self, x: jnp.ndarray) -> jnp.ndarray:
         return _assemble_sharded(x, self)
@@ -74,15 +88,66 @@ class ShardTables(NamedTuple):
 jax.tree_util.register_pytree_node(
     ShardTables,
     lambda t: ((t.pack, t.src, t.sign, t.dest_s, t.dest, t.idx, t.w),
-               (t.mesh, t.B, t.S, t.L, t.g, t.dim)),
+               (t.mesh, t.B, t.S, t.L, t.g, t.dim, t.offsets, t.mode)),
     lambda aux, ch: ShardTables(*ch, *aux),
 )
 
 
-def shard_tables(t: HaloTables, n_pad: int, mesh: Mesh) -> ShardTables:
+def _build_exchange_plan(remote_by_d, D: int, B: int, n_pad: int,
+                         mode: str):
+    """Common surface-exchange plan from the per-consumer remote-block
+    sets: returns (offsets, S, pack[D, n_off, S], g2surf[D, n_pad])
+    where g2surf[d, gblk] is the position of remote block gblk in
+    consumer d's received-surface space (-1 if not received). Shared by
+    the halo gather and the flux-correction deposit exchange so the two
+    plans can never drift (code-review r4)."""
+    if mode == "allgather":
+        # one shared surface set per owner, broadcast to every device
+        surf_lists: list[list[int]] = [[] for _ in range(D)]
+        surf_pos: dict[int, int] = {}
+        for d in range(D):
+            for gblk in remote_by_d[d].tolist():
+                if gblk not in surf_pos:
+                    surf_pos[gblk] = len(surf_lists[gblk // B])
+                    surf_lists[gblk // B].append(gblk)
+        S = _bucket(max((len(x) for x in surf_lists), default=1), lo=4)
+        offsets: tuple = ()
+        pack = np.zeros((D, 1, S), np.int32)
+        for e, lst in enumerate(surf_lists):
+            pack[e, 0, :len(lst)] = np.asarray(lst, np.int64) - e * B
+        g2surf = np.full((D, n_pad), -1, np.int64)
+        for gblk, p in surf_pos.items():
+            g2surf[:, gblk] = (gblk // B) * S + p
+        return offsets, S, pack, g2surf
+    # per (owner, offset) send lists; offset = consumer - owner
+    send: dict = {}
+    for d in range(D):
+        for gblk in remote_by_d[d].tolist():
+            e = gblk // B
+            send.setdefault((e, d - e), []).append(gblk)
+    offsets = tuple(sorted({o for (_, o) in send}))
+    n_off = max(len(offsets), 1)
+    S = _bucket(max((len(v) for v in send.values()), default=1), lo=4)
+    pack = np.zeros((D, n_off, S), np.int32)
+    g2surf = np.full((D, n_pad), -1, np.int64)
+    for (e, o), lst in send.items():
+        oi = offsets.index(o)
+        pack[e, oi, :len(lst)] = np.asarray(lst, np.int64) - e * B
+        for p, gblk in enumerate(lst):
+            g2surf[e + o, gblk] = oi * S + p
+    return offsets, S, pack, g2surf
+
+
+def shard_tables(t: HaloTables, n_pad: int, mesh: Mesh,
+                 mode: str = "ppermute") -> ShardTables:
     """Split (unpadded, numpy-leaf) tables into per-device rows with a
     surface-buffer exchange plan. ``n_pad`` must divide by the mesh
-    size (amr buckets are powers of two >= 128)."""
+    size (amr buckets are powers of two >= 128).
+
+    mode="ppermute" (default): per-offset neighbor sends; traffic per
+    device scales with its OWN shard boundary. mode="allgather": the
+    round-3 mesh-wide surface all-gather, kept for the comm-scaling
+    audit (validation/comm_audit.py measures both)."""
     D = mesh.devices.size
     assert n_pad % D == 0, (n_pad, D)
     B = n_pad // D
@@ -107,35 +172,24 @@ def shard_tables(t: HaloTables, n_pad: int, mesh: Mesh) -> ShardTables:
     src_blk = src // bs2
     idx_blk = idx // bs2
 
-    # -- surface sets ----------------------------------------------------
-    # blocks referenced by rows of device d but owned elsewhere
-    surf_lists: list[list[int]] = [[] for _ in range(D)]
-    surf_pos: dict[int, int] = {}
+    # remote blocks referenced by each consumer device
+    remote_by_d = []
     for d in range(D):
         ref = np.concatenate([
             src_blk[dev_s == d],
             idx_blk[dev_g == d][~zmask[dev_g == d]],
         ])
-        remote = np.unique(ref[(ref < d * B) | (ref >= (d + 1) * B)])
-        for gblk in remote.tolist():
-            if gblk not in surf_pos:
-                e = gblk // B
-                surf_pos[gblk] = len(surf_lists[e])   # position within e
-                surf_lists[e].append(gblk)
-    S = _bucket(max((len(x) for x in surf_lists), default=1), lo=4)
-    pack = np.zeros((D, S), np.int32)
-    for e, lst in enumerate(surf_lists):
-        pack[e, :len(lst)] = np.asarray(lst, np.int64) - e * B
-    # global block -> index into the all-gathered [D*S] surface buffer
-    g2surf = np.full(n_pad, -1, np.int64)
-    for gblk, p in surf_pos.items():
-        g2surf[gblk] = (gblk // B) * S + p
+        remote_by_d.append(
+            np.unique(ref[(ref < d * B) | (ref >= (d + 1) * B)]))
+
+    offsets, S, pack, g2surf = _build_exchange_plan(
+        remote_by_d, D, B, n_pad, mode)
 
     def remap_cells(cells, d, dead_local=None):
         blk = cells // bs2
         off = cells % bs2
         local = (blk >= d * B) & (blk < (d + 1) * B)
-        sidx = g2surf[np.clip(blk, 0, n_pad - 1)]
+        sidx = g2surf[d, np.clip(blk, 0, n_pad - 1)]
         out = np.where(local, (blk - d * B) * bs2 + off,
                        (B + sidx) * bs2 + off)
         if dead_local is not None:
@@ -173,6 +227,7 @@ def shard_tables(t: HaloTables, n_pad: int, mesh: Mesh) -> ShardTables:
         pack=pack, src=pk_src, sign=pk_sign, dest_s=pk_dest_s,
         dest=pk_dest, idx=pk_idx, w=pk_w,
         mesh=mesh, B=B, S=S, L=L, g=g, dim=dim,
+        offsets=offsets, mode=mode,
     ))
 
 
@@ -183,11 +238,33 @@ def _put_shard_tables(mesh: Mesh, t):
     return jax.tree_util.tree_unflatten(treedef, put)
 
 
+def _exchange_surface(x_loc, pack, t: "ShardTables"):
+    """Surface-block exchange inside shard_map: per-offset ppermute
+    sends (default) or the mesh-wide all-gather (audit mode). Returns
+    the received surface blocks [R, ...] to append after the B own
+    blocks. The ppermute issue order matters for overlap: all sends
+    start before any consumer indexes the results, so XLA can overlap
+    them with the local lab initialization below."""
+    D = t.mesh.devices.size
+    if t.mode == "allgather":
+        surf = x_loc[pack[0]]                       # [S, dim, bs, bs]
+        asurf = jax.lax.all_gather(surf, "x")       # [D, S, ...]
+        return asurf.reshape((D * t.S,) + x_loc.shape[1:])
+    parts = []
+    for oi, o in enumerate(t.offsets):
+        buf = x_loc[pack[oi]]                       # [S, ...] to owner+o
+        perm = [(e, e + o) for e in range(D) if 0 <= e + o < D]
+        parts.append(jax.lax.ppermute(buf, "x", perm=perm))
+    if not parts:
+        return jnp.zeros((0,) + x_loc.shape[1:], x_loc.dtype)
+    return jnp.concatenate(parts, axis=0)           # [n_off*S, ...]
+
+
 def _assemble_sharded(x: jnp.ndarray, t: ShardTables) -> jnp.ndarray:
     """[n_pad, dim, BS, BS] ordered field -> [n_pad, dim, L, L] labs,
-    sharded on the block axis; comm = one surface-buffer all-gather."""
-    D = t.mesh.devices.size
-    B, S, L, g, dim = t.B, t.S, t.L, t.g, t.dim
+    sharded on the block axis; comm = per-offset neighbor ppermutes
+    (or one surface all-gather in audit mode)."""
+    B, L, g, dim = t.B, t.L, t.g, t.dim
     bs = L - 2 * g
 
     @partial(jax.shard_map, mesh=t.mesh,
@@ -195,10 +272,8 @@ def _assemble_sharded(x: jnp.ndarray, t: ShardTables) -> jnp.ndarray:
     def run(x_loc, pack, src, sign, dest_s, dest, idx, w):
         pack, src, sign, dest_s, dest, idx, w = (
             a[0] for a in (pack, src, sign, dest_s, dest, idx, w))
-        surf = x_loc[pack]                              # [S, dim, bs, bs]
-        asurf = jax.lax.all_gather(surf, "x")           # [D, S, ...]
-        blocks = jnp.concatenate(
-            [x_loc, asurf.reshape(D * S, dim, bs, bs)], axis=0)
+        recv = _exchange_surface(x_loc, pack, t)
+        blocks = jnp.concatenate([x_loc, recv], axis=0)
         flat = blocks.transpose(1, 0, 2, 3).reshape(dim, -1)
         simple = flat[:, src].T * sign                  # [Gs, dim]
         general = jnp.einsum("dgk,gkd->gd", flat[:, idx], w)
@@ -220,10 +295,11 @@ def _assemble_sharded(x: jnp.ndarray, t: ShardTables) -> jnp.ndarray:
 
 class ShardFluxCorr(NamedTuple):
     """Per-device flux-correction rows. Deposit index space per device:
-    [B own blocks ++ D*S surface blocks] x 4 faces x BS; value dests are
-    local cells [B*BS*BS] ++ 1 scratch."""
+    [B own blocks ++ received surface blocks] x 4 faces x BS; value
+    dests are local cells [B*BS*BS] ++ 1 scratch. Exchange modes as in
+    ShardTables."""
 
-    pack: jnp.ndarray    # [D, S] own-block indices whose deposits export
+    pack: jnp.ndarray    # [D, n_off, S] own blocks whose deposits export
     dest: jnp.ndarray    # [D, M]
     cidx: jnp.ndarray    # [D, M]
     fidx1: jnp.ndarray   # [D, M]
@@ -233,6 +309,8 @@ class ShardFluxCorr(NamedTuple):
     B: int
     S: int
     bs: int
+    offsets: tuple
+    mode: str
 
     def apply(self, values, deposits):
         return _apply_corr_sharded(values, deposits, self)
@@ -241,13 +319,14 @@ class ShardFluxCorr(NamedTuple):
 jax.tree_util.register_pytree_node(
     ShardFluxCorr,
     lambda t: ((t.pack, t.dest, t.cidx, t.fidx1, t.fidx2, t.valid),
-               (t.mesh, t.B, t.S, t.bs)),
+               (t.mesh, t.B, t.S, t.bs, t.offsets, t.mode)),
     lambda aux, ch: ShardFluxCorr(*ch, *aux),
 )
 
 
 def shard_flux_corr(corr, n_pad: int, mesh: Mesh, bs: int,
-                    dtype=np.float32) -> ShardFluxCorr:
+                    dtype=np.float32,
+                    mode: str = "ppermute") -> ShardFluxCorr:
     """Split (unpadded) FluxCorrTables by owning coarse block."""
     D = mesh.devices.size
     assert n_pad % D == 0
@@ -260,28 +339,20 @@ def shard_flux_corr(corr, n_pad: int, mesh: Mesh, bs: int,
     f2 = np.asarray(corr.fidx2, np.int64)
     dev = (dest // bs2) // B
 
-    surf_lists: list[list[int]] = [[] for _ in range(D)]
-    surf_pos: dict[int, int] = {}
+    remote_by_d = []
     for d in range(D):
         ref = np.concatenate([a[dev == d] // fb for a in (cidx, f1, f2)])
-        remote = np.unique(ref[(ref < d * B) | (ref >= (d + 1) * B)])
-        for gblk in remote.tolist():
-            if gblk not in surf_pos:
-                surf_pos[gblk] = len(surf_lists[gblk // B])
-                surf_lists[gblk // B].append(gblk)
-    S = _bucket(max((len(x) for x in surf_lists), default=1), lo=4)
-    pack = np.zeros((D, S), np.int32)
-    for e, lst in enumerate(surf_lists):
-        pack[e, :len(lst)] = np.asarray(lst, np.int64) - e * B
-    g2surf = np.full(n_pad, -1, np.int64)
-    for gblk, p in surf_pos.items():
-        g2surf[gblk] = (gblk // B) * S + p
+        remote_by_d.append(
+            np.unique(ref[(ref < d * B) | (ref >= (d + 1) * B)]))
+
+    offsets, S, pack, g2surf = _build_exchange_plan(
+        remote_by_d, D, B, n_pad, mode)
 
     def remap_dep(cells, d):
         blk = cells // fb
         off = cells % fb
         local = (blk >= d * B) & (blk < (d + 1) * B)
-        sidx = g2surf[np.clip(blk, 0, n_pad - 1)]
+        sidx = g2surf[d, np.clip(blk, 0, n_pad - 1)]
         assert not ((~local) & (sidx < 0)).any()
         return np.where(local, (blk - d * B) * fb + off,
                         (B + sidx) * fb + off)
@@ -304,12 +375,12 @@ def shard_flux_corr(corr, n_pad: int, mesh: Mesh, bs: int,
     return _put_shard_tables(mesh, ShardFluxCorr(
         pack=pack, dest=pk_dest, cidx=pk_c, fidx1=pk_f1, fidx2=pk_f2,
         valid=pk_v, mesh=mesh, B=B, S=S, bs=bs,
+        offsets=offsets, mode=mode,
     ))
 
 
 def _apply_corr_sharded(values, deposits, t: ShardFluxCorr):
-    D = t.mesh.devices.size
-    B, S, bs = t.B, t.S, t.bs
+    B, bs = t.B, t.bs
     vec = values.ndim == 4
 
     @partial(jax.shard_map, mesh=t.mesh,
@@ -317,10 +388,8 @@ def _apply_corr_sharded(values, deposits, t: ShardFluxCorr):
     def run(v_loc, d_loc, pack, dest, cidx, f1, f2, valid):
         pack, dest, cidx, f1, f2, valid = (
             a[0] for a in (pack, dest, cidx, f1, f2, valid))
-        surf = d_loc[pack]
-        asurf = jax.lax.all_gather(surf, "x")
-        dep = jnp.concatenate(
-            [d_loc, asurf.reshape((D * S,) + d_loc.shape[1:])], axis=0)
+        recv = _exchange_surface(d_loc, pack, t)
+        dep = jnp.concatenate([d_loc, recv], axis=0)
         if vec:
             dim = v_loc.shape[1]
             df = dep.reshape(-1, dim)

@@ -99,10 +99,15 @@ class Simulation(ShapeHostMixin):
             w = int(np.ceil(1.25 * s.length / g.h)) + 12
             self._wins.append((min(w, g.nx), min(w, g.ny)))
         self._rasterize = jax.jit(self._rasterize_impl)
+        # donate the state (arg 0) so pass-through fields aren't copied
+        # every step; obs is NOT donated — _log_forces reads it after
+        # the flow step returns
         self._flow_step = jax.jit(
-            self._flow_step_impl, static_argnames=("exact_poisson",))
+            self._flow_step_impl, donate_argnums=(0,),
+            static_argnames=("exact_poisson",))
         self._flow_step_empty = jax.jit(
-            g.step, static_argnames=("exact_poisson",))
+            g.step, donate_argnums=(0,),
+            static_argnames=("exact_poisson", "obstacle_terms"))
         self._forces = jax.jit(self._forces_impl)
         self._dt = jax.jit(g.compute_dt)
         self.compute_forces_every = 1   # 0 disables the diagnostics pass
@@ -354,7 +359,7 @@ class Simulation(ShapeHostMixin):
             with tm.phase("flow"):
                 self.state, diag = self._flow_step_empty(
                     self.state, jnp.asarray(dt, g.dtype),
-                    exact_poisson=exact)
+                    exact_poisson=exact, obstacle_terms=False)
                 # dt_next computed on device inside the step; one pull
                 self._next_dt = float(diag["dt_next"])
             self.time += dt

@@ -26,10 +26,11 @@ import numpy as np
 from .config import SimConfig
 from .ops.stencil import (
     advect_diffuse_rhs,
-    divergence_rhs,
+    divergence_freeslip,
+    divergence_rhs_fused,
     dt_from_umax,
-    laplacian5,
-    pressure_gradient_update,
+    laplacian5_neumann,
+    pressure_gradient_update_fused,
     vorticity,
 )
 from .poisson import (
@@ -57,14 +58,16 @@ def pad_vector(v: jnp.ndarray, g: int) -> jnp.ndarray:
     """[..., 2, Ny, Nx] -> [..., 2, Ny+2g, Nx+2g], free-slip mirror
     (VectorLab::applyBCface): u flips sign in x-ghost columns, v flips in
     y-ghost rows; corners compose both flips — exactly the reference's
-    two-pass face sweep."""
-    ny, nx = v.shape[-2], v.shape[-1]
+    two-pass face sweep. Sign flips touch only the g-wide ghost STRIPS
+    (in-place slice updates) instead of a whole-array multiply+stack —
+    the latter cost two extra full-field passes per lab (~6.6 ms/step at
+    8192^2 in the round-3 trace)."""
     out = pad_scalar(v, g)
-    sx = jnp.ones(nx + 2 * g, dtype=v.dtype).at[:g].set(-1).at[nx + g :].set(-1)
-    sy = jnp.ones(ny + 2 * g, dtype=v.dtype).at[:g].set(-1).at[ny + g :].set(-1)
-    u = out[..., 0, :, :] * sx[None, :]
-    w = out[..., 1, :, :] * sy[:, None]
-    return jnp.stack([u, w], axis=-3)
+    out = out.at[..., 0, :, :g].multiply(-1.0)
+    out = out.at[..., 0, :, -g:].multiply(-1.0)
+    out = out.at[..., 1, :g, :].multiply(-1.0)
+    out = out.at[..., 1, -g:, :].multiply(-1.0)
+    return out
 
 
 class FlowState(NamedTuple):
@@ -109,7 +112,12 @@ class UniformGrid:
     default."""
 
     def __init__(self, cfg: SimConfig, level: Optional[int] = None,
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None,
+                 spmd_safe: bool = False):
+        # spmd_safe: the fused-BC stencil forms have a fast pad+slice
+        # variant this image's GSPMD partitioner miscompiles on sharded
+        # axes (see ops/stencil._zshift); sharded sims set True
+        self.spmd_safe = spmd_safe
         self.cfg = cfg
         lvl = cfg.level_start if level is None else level
         if use_pallas is None:
@@ -128,7 +136,8 @@ class UniformGrid:
         # multigrid V-cycle preconditioner: O(1) Krylov iterations in N,
         # where the reference's single-level block-Jacobi (kept above for
         # the oracle/AMR paths) degrades linearly in N_1d/BS
-        self.mg = MultigridPreconditioner(self.ny, self.nx, self.dtype)
+        self.mg = MultigridPreconditioner(self.ny, self.nx, self.dtype,
+                                          spmd_safe=spmd_safe)
         # f64 dot-product accumulation when fields are f32 AND x64 is
         # available (the Krylov scalars are precision-critical, SURVEY.md §7
         # hard part 5). Without x64, XLA's tree reduction keeps f32 error at
@@ -146,9 +155,16 @@ class UniformGrid:
         return np.meshgrid(x, y, indexing="xy")  # X[j,i], Y[j,i] -> [Ny, Nx]
 
     def zero_state(self) -> FlowState:
-        z = jnp.zeros((self.ny, self.nx), dtype=self.dtype)
-        zv = jnp.zeros((2, self.ny, self.nx), dtype=self.dtype)
-        return FlowState(vel=zv, pres=z, chi=z, us=zv, udef=zv)
+        # distinct buffers per field: the stepping jits donate the state,
+        # and donating one aliased buffer through several fields is a
+        # runtime error ("donate the same buffer twice")
+        def z():
+            return jnp.zeros((self.ny, self.nx), dtype=self.dtype)
+
+        def zv():
+            return jnp.zeros((2, self.ny, self.nx), dtype=self.dtype)
+
+        return FlowState(vel=zv(), pres=z(), chi=z(), us=zv(), udef=zv())
 
     # -- dt control (main.cpp:6579-6595) --
     def dt_from_umax(self, umax) -> jnp.ndarray:
@@ -160,8 +176,10 @@ class UniformGrid:
         return self.dt_from_umax(jnp.max(jnp.abs(vel)))
 
     # -- Poisson operator: undivided 5-point Laplacian w/ Neumann walls --
+    # (fused-BC form: zero-ghost shifts + rank-1 edge correction, no
+    # edge-mode pad concatenates — see ops/stencil.laplacian5_neumann)
     def laplacian(self, p: jnp.ndarray) -> jnp.ndarray:
-        return laplacian5(pad_scalar(p, 1), 1)
+        return laplacian5_neumann(p, self.spmd_safe)
 
     def precond(self, r: jnp.ndarray) -> jnp.ndarray:
         return apply_block_precond(r, self.p_inv, self.cfg.bs)
@@ -209,16 +227,19 @@ class UniformGrid:
         """deltap pressure solve + velocity correction
         (main.cpp:7007-7187): b = (h/2dt)[div u* - chi div u_def] -
         lap(pold); p = dp + pold (both mean-free); u += -dt/(2h) grad p.
-        Returns (vel, pres, solver_result)."""
+        Returns (vel, pres, solver_result). ``chi=None`` (obstacle-free
+        callers) drops the identically-zero chi*div(u_def) term."""
         h = self.h
         ih2 = 1.0 / (h * h)
-        b = divergence_rhs(
-            pad_vector(vel, 1), pad_vector(udef, 1), chi, 1, h, dt)
-        b = b - laplacian5(pad_scalar(pres_old, 1), 1)
+        if chi is None:
+            b = (0.5 * h / dt) * divergence_freeslip(vel, self.spmd_safe)
+        else:
+            b = divergence_rhs_fused(vel, udef, chi, h, dt, self.spmd_safe)
+        b = b - laplacian5_neumann(pres_old, self.spmd_safe)
         res = self.pressure_solve(b, exact=exact_poisson)
         dp = res.x - jnp.mean(res.x)
         pres = dp + pres_old - jnp.mean(pres_old)
-        dv = pressure_gradient_update(pad_scalar(pres, 1), 1, h, dt)
+        dv = pressure_gradient_update_fused(pres, h, dt, self.spmd_safe)
         return vel + dv * ih2, pres, res
 
     def step_diag(self, vel, res) -> dict:
@@ -235,17 +256,28 @@ class UniformGrid:
 
     # -- one full projection step (the reference hot loop 6576-7290) --
     def step(self, state: FlowState, dt: jnp.ndarray,
-             exact_poisson: bool = False) -> tuple[FlowState, dict]:
+             exact_poisson: bool = False,
+             obstacle_terms: bool = True) -> tuple[FlowState, dict]:
+        """``obstacle_terms=False`` statically drops the penalization
+        update and the chi*div(u_def) RHS term — they are identically
+        zero without shapes, but XLA cannot know that and spends ~4 ms
+        of full-field passes on them at 8192^2. The obstacle-free
+        drivers (UniformSim, Simulation's empty branch, bench.py) pass
+        False; the shaped path never calls this (it penalizes in
+        Simulation._flow_step_impl)."""
         cfg = self.cfg
         vel = self.advect_heun(state.vel, dt)
 
-        # Brinkman penalization implicit update (main.cpp:6961-6977):
-        # alpha = chi > 0.5 ? 1/(1 + lambda dt) : 1;  u <- alpha u + (1-alpha) u_s
-        alpha = jnp.where(state.chi > 0.5, 1.0 / (1.0 + cfg.lam * dt), 1.0)
-        vel = alpha * vel + (1.0 - alpha) * state.us
+        if obstacle_terms:
+            # Brinkman penalization implicit update (main.cpp:6961-6977):
+            # alpha = chi>0.5 ? 1/(1+lambda dt) : 1; u <- alpha u + (1-alpha) u_s
+            alpha = jnp.where(state.chi > 0.5, 1.0 / (1.0 + cfg.lam * dt), 1.0)
+            vel = alpha * vel + (1.0 - alpha) * state.us
 
         vel, pres, res = self.project(
-            vel, state.pres, state.chi, state.udef, dt, exact_poisson)
+            vel, state.pres,
+            state.chi if obstacle_terms else None,
+            state.udef if obstacle_terms else None, dt, exact_poisson)
         return state._replace(vel=vel, pres=pres), self.step_diag(vel, res)
 
     def vorticity_field(self, vel: jnp.ndarray) -> jnp.ndarray:
@@ -255,13 +287,22 @@ class UniformGrid:
 class UniformSim:
     """Host-side driver: owns time/step counters, jits the device step."""
 
-    def __init__(self, cfg: SimConfig, level: Optional[int] = None):
-        self.grid = UniformGrid(cfg, level)
+    def __init__(self, cfg: SimConfig, level: Optional[int] = None,
+                 spmd_safe: bool = False):
+        self.grid = UniformGrid(cfg, level, spmd_safe=spmd_safe)
         self.cfg = cfg
         self.state = self.grid.zero_state()
         self.time = 0.0
         self.step_count = 0
-        self._step = jax.jit(self.grid.step, static_argnames=("exact_poisson",))
+        # donate the state: without it XLA copies the pass-through
+        # fields (us/udef/chi) every step — 3.3 ms/step of dead copies
+        # at 8192^2 (round-4 trace). Callers read the NEW state from the
+        # return value; the donated input buffers are invalidated.
+        # UniformSim is the obstacle-free driver, so the obstacle terms
+        # are statically dropped.
+        self._step = jax.jit(
+            self.grid.step, donate_argnums=(0,),
+            static_argnames=("exact_poisson", "obstacle_terms"))
         self._dt = jax.jit(self.grid.compute_dt)
 
     def advance(self, n_steps: int = 1, tend: Optional[float] = None,
@@ -278,7 +319,8 @@ class UniformSim:
                 dt = min(dt, tend - self.time + 1e-15)
             exact = exact_first_steps and self.step_count < 10
             self.state, diag = self._step(
-                self.state, jnp.asarray(dt, self.grid.dtype), exact_poisson=exact
+                self.state, jnp.asarray(dt, self.grid.dtype),
+                exact_poisson=exact, obstacle_terms=False,
             )
             self.time += dt
             self.step_count += 1

@@ -21,36 +21,45 @@ from cup2d_tpu.parallel.mesh import make_mesh
 from validation.comm_audit import _COLL_RE
 
 
-@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
-def test_megastep_comm_is_boundary_proportional():
+def _build_sim(initialize=True):
     cfg = SimConfig(bpdx=2, bpdy=1, level_max=3, level_start=1,
                     extent=1.0, dtype="float32", nu=4e-5, lam=1e6,
                     rtol=2.0, ctol=1.0)
     mesh = make_mesh(8)
     sim = ShardedAMRSim(cfg, mesh, shapes=[DiskShape(0.08, 0.55, 0.25)])
     sim.compute_forces_every = 0
-    sim.initialize()
+    if initialize:
+        sim.initialize()
+    return cfg, sim
 
+
+def _capture(sim, attr, trigger):
+    """Swap the jitted callable at ``attr`` for a capturing wrapper,
+    run ``trigger``, return the compiled HLO text of the real call."""
     captured = {}
-    orig = sim._mega_jit
+    orig = getattr(sim, attr)
 
     def wrapper(*a, **k):
         captured["a"], captured["k"] = a, k
         return orig(*a, **k)
 
-    sim._mega_jit = wrapper
-    sim.step_once(dt=1e-3)
-    assert captured, "megastep never ran"
-    txt = orig.lower(*captured["a"], **captured["k"]).compile().as_text()
+    setattr(sim, attr, wrapper)
+    try:
+        trigger()
+    finally:
+        setattr(sim, attr, orig)
+    assert captured, f"{attr} never ran"
+    return orig.lower(*captured["a"], **captured["k"]).compile().as_text()
 
-    # the only legitimate large exchange is an all-gathered surface
-    # buffer [D, S, dim, BS, BS] (shard_halo) — leading dim D. Anything
-    # whose element count reaches even a SCALAR field's volume without
-    # that structure is the GSPMD whole-field fallback (the round-2
-    # regression re-issued it per Krylov iteration).
+
+def _assert_boundary_proportional(txt, sim, cfg, what):
+    """No collective in ``txt`` may reach a scalar field's volume; the
+    only large exchanges allowed are the shard_halo surface forms
+    (per-offset collective-permutes, or the [D, S, ...] surface
+    all-gather in audit mode)."""
     n_pad = sim._npad_hwm
     bs = cfg.bs
-    n_dev = 8
+    n_dev = sim.mesh.devices.size
     smax = max(t.S for t in sim._tables.values() if hasattr(t, "S"))
     scalar_field_elems = n_pad * bs * bs
     surface_elems_cap = n_dev * 4 * smax * 2 * bs * bs  # 4x slack
@@ -68,9 +77,85 @@ def test_megastep_comm_is_boundary_proportional():
         surface_like = (op == "all-gather" and dim_list
                         and dim_list[0] == n_dev
                         and elems <= surface_elems_cap)
-        if elems >= scalar_field_elems and not surface_like:
+        permute_like = (op == "collective-permute"
+                        and elems <= surface_elems_cap)
+        if elems >= scalar_field_elems and not (
+                surface_like or permute_like):
             offenders.append((op, f"{dt_}[{dims}]", elems))
-    assert n_coll > 0, "no collectives at all — not actually sharded?"
+    assert n_coll > 0, f"no collectives in {what} — not actually sharded?"
     assert not offenders, (
-        f"volume-sized collectives in the megastep "
+        f"volume-sized collectives in {what} "
         f"(scalar field = {scalar_field_elems} elems): {offenders}")
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_per_step_comm_is_boundary_proportional():
+    """Megastep + rasterize + tags (every per-STEP / per-tag
+    executable); the regrid APPLY is exempt — volume-sized by design,
+    like the reference's migration (main.cpp:5205-5424)."""
+    cfg, sim = _build_sim(initialize=False)
+
+    # the standalone rasterize executable runs during initialize()
+    # (per-STEP rasterization is fused into the megastep, guarded below)
+    txt_raster = _capture(sim, "_raster_jit", sim.initialize)
+    _assert_boundary_proportional(txt_raster, sim, cfg, "rasterize")
+
+    txt = _capture(sim, "_mega_jit", lambda: sim.step_once(dt=1e-3))
+    _assert_boundary_proportional(txt, sim, cfg, "megastep")
+
+    txt = _capture(sim, "_tags_jit", sim.adapt)
+    _assert_boundary_proportional(txt, sim, cfg, "tags")
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_surface_bucket_tracks_shard_boundary():
+    """The exchanged surface bucket S must be bounded by the GEOMETRIC
+    shard boundary (blocks whose 3x3 spatial neighborhood, at same /
+    coarser / finer level, crosses a shard range) — a builder change
+    that silently inflates the exchanged set to shard volume would pass
+    the HLO-shape test above but fail this one."""
+    from cup2d_tpu.halo import _bucket
+
+    cfg, sim = _build_sim()
+    sim._refresh()
+    f = sim.forest
+    order = f.order()
+    n_pad = sim._npad_hwm
+    D = sim.mesh.devices.size
+    B = n_pad // D
+    pos = {tuple(k): i for i, k in enumerate(
+        np.stack([f.level[order], f.bi[order], f.bj[order]], axis=1))}
+
+    def owner(i):
+        return i // B
+
+    # geometric boundary: for each block, every same/coarser/finer
+    # neighbor key that exists; count blocks with any cross-shard edge
+    boundary = np.zeros(D, np.int64)
+    for (lvl, bi, bj), i in pos.items():
+        cross = False
+        for di in (-1, 0, 1):
+            for dj in (-1, 0, 1):
+                if di == dj == 0:
+                    continue
+                ni, nj = bi + di, bj + dj
+                cands = [(lvl, ni, nj), (lvl - 1, ni // 2, nj // 2)]
+                cands += [(lvl + 1, 2 * ni + a, 2 * nj + b)
+                          for a in (0, 1) for b in (0, 1)]
+                for key in cands:
+                    j = pos.get(key)
+                    if j is not None and owner(j) != owner(i):
+                        cross = True
+        if cross:
+            boundary[owner(i)] += 1
+    bmax = int(boundary.max())
+    assert bmax > 0, "test forest has no shard boundary?"
+
+    for name, t in sim._tables.items():
+        if not hasattr(t, "S"):
+            continue
+        # S is a per-(pair|owner) bucket: bounded by the bucket of the
+        # worst geometric boundary (2x slack for the K-padding bucket
+        # rounding and edge-interface double counting)
+        assert t.S <= 2 * _bucket(bmax, lo=4), (
+            name, t.S, bmax, _bucket(bmax, lo=4))

@@ -1,0 +1,170 @@
+"""Fused-BC operator forms vs the padded-lab originals.
+
+The uniform path's linear operators (Laplacian, divergence, pressure
+gradient) fold their physical BCs into zero-ghost shifts plus rank-1
+edge corrections (ops/stencil.py) instead of edge-mode pads, whose
+concatenate lowering dominated the round-3 halo-pad trace slice. The
+algebra is identical; only the summation order differs in wall cells —
+these tests pin the two forms against each other, and pin the strip-
+flip pad_vector against the reference's two-pass BC sweep semantics.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from cup2d_tpu.ops.stencil import (
+    divergence,
+    divergence_freeslip,
+    divergence_rhs,
+    divergence_rhs_fused,
+    laplacian5,
+    laplacian5_neumann,
+    pressure_gradient_update,
+    pressure_gradient_update_fused,
+)
+from cup2d_tpu.uniform import pad_scalar, pad_vector
+
+
+def _rand(shape, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).normal(size=shape))
+
+
+def test_laplacian_fused_matches_padded():
+    p = _rand((24, 40))
+    a = laplacian5(pad_scalar(p, 1), 1)
+    b = laplacian5_neumann(p)
+    assert np.allclose(np.asarray(a), np.asarray(b), rtol=0, atol=1e-13)
+
+
+def test_divergence_fused_matches_padded():
+    v = _rand((2, 24, 40), seed=1)
+    a = divergence(pad_vector(v, 1), 1)
+    b = divergence_freeslip(v)
+    assert np.allclose(np.asarray(a), np.asarray(b), rtol=0, atol=1e-13)
+
+
+def test_divergence_rhs_fused_matches_padded():
+    v = _rand((2, 16, 24), seed=2)
+    u = _rand((2, 16, 24), seed=3)
+    chi = jnp.abs(_rand((16, 24), seed=4))
+    a = divergence_rhs(pad_vector(v, 1), pad_vector(u, 1), chi, 1,
+                       0.01, 1e-3)
+    b = divergence_rhs_fused(v, u, chi, 0.01, 1e-3)
+    assert np.allclose(np.asarray(a), np.asarray(b), rtol=1e-12, atol=1e-12)
+
+
+def test_gradient_fused_matches_padded():
+    p = _rand((24, 40), seed=5)
+    a = pressure_gradient_update(pad_scalar(p, 1), 1, 0.01, 1e-3)
+    b = pressure_gradient_update_fused(p, 0.01, 1e-3)
+    assert np.allclose(np.asarray(a), np.asarray(b), rtol=0, atol=1e-15)
+
+
+def test_pad_vector_strip_flips_match_full_sweep():
+    """pad_vector's strip-wise sign flips must reproduce the original
+    whole-array two-pass sweep: u negated in ALL x-ghost columns, v in
+    ALL y-ghost rows, corners composing both."""
+    v = _rand((2, 10, 14), seed=6)
+    g = 3
+    out = np.asarray(pad_vector(v, g))
+    ny, nx = 10, 14
+    ref = np.array(pad_scalar(v, g))   # writable copy
+    sx = np.ones(nx + 2 * g)
+    sx[:g] = -1
+    sx[nx + g:] = -1
+    sy = np.ones(ny + 2 * g)
+    sy[:g] = -1
+    sy[ny + g:] = -1
+    ref[0] *= sx[None, :]
+    ref[1] *= sy[:, None]
+    assert np.array_equal(out, ref)
+
+
+def test_mg_lap_fused_neumann():
+    """MultigridPreconditioner._lap (now the fused form) still applies
+    the zero-Neumann operator its Jacobi diagonal assumes — in both
+    _zshift variants."""
+    from cup2d_tpu.poisson import MultigridPreconditioner
+
+    p = _rand((16, 16), seed=7)
+    pp = jnp.pad(p, 1, mode="edge")
+    b = (pp[:-2, 1:-1] + pp[2:, 1:-1] + pp[1:-1, :-2]
+         + pp[1:-1, 2:] - 4.0 * p)
+    for safe in (False, True):
+        mg = MultigridPreconditioner(16, 16, jnp.float64, spmd_safe=safe)
+        a = mg._lap(p)
+        assert np.allclose(np.asarray(a), np.asarray(b), rtol=0,
+                           atol=1e-13), safe
+
+
+def test_weno_mirror_identity_bit_exact():
+    """weno_derivative's pre-selection form must be BIT-identical to
+    the textbook both-branches-then-select form in both dtypes (the
+    mirror identity weno5_minus(a..e) == weno5_plus(e..a) plus
+    commutative adds only)."""
+    from cup2d_tpu.ops.stencil import (
+        weno5_minus,
+        weno5_plus,
+        weno_derivative,
+    )
+
+    rng = np.random.default_rng(3)
+    for dtype in (jnp.float64, jnp.float32):
+        args = [jnp.asarray(rng.normal(size=5000), dtype)
+                for _ in range(7)]
+        wind = jnp.asarray(rng.normal(size=5000), dtype)
+        um3, um2, um1, u, up1, up2, up3 = args
+        dplus = weno5_plus(um2, um1, u, up1, up2) \
+            - weno5_plus(um3, um2, um1, u, up1)
+        dminus = weno5_minus(um1, u, up1, up2, up3) \
+            - weno5_minus(um2, um1, u, up1, up2)
+        old = jnp.where(wind > 0, dplus, dminus)
+        new = weno_derivative(wind, *args)
+        assert bool(jnp.all(old == new)), dtype
+        a, b, c, d, e = args[:5]
+        assert bool(jnp.all(
+            weno5_minus(a, b, c, d, e) == weno5_plus(e, d, c, b, a)))
+
+
+def test_weno_fast_weights_match_ref_form_f32():
+    """The f32 production branch of _weno5_weights (max-normalized
+    cross products + the 0x7EF311C3 bit-trick scale reciprocal) must
+    match the reference ratio form to f32 roundoff across 16 orders of
+    magnitude of smoothness — the weights are exactly scale-invariant
+    in the normalizer, so even a ~15%-error reciprocal cannot move
+    them. The CPU suite otherwise only exercises the f64 exact-divide
+    branch."""
+    from cup2d_tpu.ops.stencil import _weno5_weights, _weno5_weights_ref
+
+    rng = np.random.default_rng(0)
+    b = [jnp.asarray(10.0 ** rng.uniform(-8, 8, 50000), jnp.float32)
+         for _ in range(3)]
+    for g in ((0.1, 0.6, 0.3), (0.3, 0.6, 0.1)):
+        wf = np.stack([np.asarray(x) for x in _weno5_weights(*b, *g)])
+        wr = np.stack([np.asarray(x)
+                       for x in _weno5_weights_ref(*b, *g)])
+        assert np.abs(wf - wr).max() < 5e-7, np.abs(wf - wr).max()
+        assert np.abs(wf.sum(0) - 1.0).max() < 5e-7
+    # overflow regime that killed the r2 single-divide form: stays
+    # finite and convex
+    bx = [jnp.asarray([2e9, 1e20, 1e38, 1e-6], jnp.float32),
+          jnp.asarray([1e-3, 1e-6, 1e-6, 1e38], jnp.float32),
+          jnp.asarray([5e8, 1e13, 1e-6, 1e20], jnp.float32)]
+    w = np.stack([np.asarray(x)
+                  for x in _weno5_weights(*bx, 0.1, 0.6, 0.3)])
+    assert np.isfinite(w).all()
+    assert np.abs(w.sum(0) - 1.0).max() < 1e-6
+
+
+def test_zshift_spmd_safe_variant_matches():
+    """Both _zshift forms agree exactly on every direction (the
+    spmd_safe slice-then-pad form exists because the partitioner
+    miscompiles the fast negative-pad form on sharded axes)."""
+    from cup2d_tpu.ops.stencil import _zshift
+
+    p = _rand((9, 13), seed=8)
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            a = _zshift(p, dy, dx, spmd_safe=False)
+            b = _zshift(p, dy, dx, spmd_safe=True)
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (dy, dx)

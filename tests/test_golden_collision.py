@@ -1,0 +1,42 @@
+"""Collision golden-trajectory regression (VERDICT r3 #8).
+
+The collision invariant tests (tests/test_collision_forces.py) pass
+under any SYMMETRIC sign error; this pins the actual two-disk
+trajectory through contact — approach, e=1 impulse exchange, rebound —
+against numbers recorded by `python -m validation.golden_collision
+--write` (CPU f64). Regenerate consciously after legitimate numerics
+changes, like the canonical golden."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from validation.golden_collision import GOLDEN_PATH, N_STEPS, \
+    run_trajectory
+
+
+@pytest.mark.skipif(not os.path.exists(GOLDEN_PATH),
+                    reason="golden_collision.json not generated")
+def test_golden_collision_trajectory():
+    with open(GOLDEN_PATH) as f:
+        want = json.load(f)
+    got = run_trajectory()
+    assert len(got["steps"]) == len(want["steps"]) == N_STEPS
+    for i, (g, w) in enumerate(zip(got["steps"], want["steps"])):
+        np.testing.assert_allclose(g["time"], w["time"], rtol=1e-12)
+        for k, (bg, bw) in enumerate(zip(g["bodies"], w["bodies"])):
+            np.testing.assert_allclose(
+                bg["com"], bw["com"], rtol=0, atol=1e-7,
+                err_msg=f"step {i} body {k} com")
+            for q in ("u", "v", "omega"):
+                np.testing.assert_allclose(
+                    bg[q], bw[q], rtol=1e-6, atol=1e-9,
+                    err_msg=f"step {i} body {k} {q}")
+    np.testing.assert_allclose(got["min_gap"], want["min_gap"],
+                               rtol=0, atol=1e-7)
+    # the pinned window must actually contain the impulse: body 0 flips
+    # from approaching (+u) to receding (-u) across step 0 -> 1
+    assert want["steps"][0]["bodies"][0]["u"] > 0.1
+    assert want["steps"][1]["bodies"][0]["u"] < -0.01

@@ -1,0 +1,139 @@
+"""Collision golden trajectory (VERDICT r3 #8).
+
+The collision model (reference main.cpp:236-291 impulse math,
+6705-6943 detection/response) has invariant tests (momentum exchange,
+receding pairs untouched) — but a sign error that happens to be
+symmetric would pass them. This pins the ACTUAL trajectory of two free
+disks driven onto a collision course through contact: per-step rigid
+states (com, u, v, omega) of both bodies on CPU f64, recorded to
+tests/golden_collision.json by `--write` and replayed by
+tests/test_golden_collision.py.
+
+The disks are set moving by seeding the FLUID with rigid-motion blobs
+(the penalization momentum solve derives body velocity from the flow,
+so seeding the bodies alone would not move them); the generator asserts
+a genuine approach->contact->rebound happened, so the golden can never
+silently pin a miss.
+
+The window is 6 steps: approach at full speed (step 0), the e=1
+impulse exchange (step 1: closing du = -0.82 flips to receding +0.21),
+and four post-impulse steps. It deliberately ENDS while the bodies are
+still distinct (min gap ~0.012): past that the converging seeded flow
+pushes the pair into quasi-static deep interpenetration, a regime the
+reference's approach-only impulse model leaves undefined (its
+chi-integral CoM recentring, main.cpp:4472-4630, then drags both
+measured centers to the midpoint — measured here, single-disk control
+shows <= 5e-4 drift, so it is overlap-specific and inherited from the
+model, not a raster bug).
+
+    JAX_PLATFORMS=cpu python -m validation.golden_collision --write
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "golden_collision.json")
+
+N_STEPS = 6
+
+
+def _force_cpu_x64():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+
+def build_sim():
+    _force_cpu_x64()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cup2d_tpu.amr import AMRSim
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.models import DiskShape
+
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=3, level_start=2,
+                    extent=1.0, dtype="float64", nu=2e-4, lam=1e6,
+                    cfl=0.4, rtol=1e9, ctol=-1.0,
+                    max_poisson_iterations=60, poisson_tol=1e-6,
+                    poisson_tol_rel=1e-4)
+    r = 0.06
+    sim = AMRSim(cfg, shapes=[DiskShape(r, 0.42, 0.5),
+                              DiskShape(r, 0.58, 0.5)])
+    sim.compute_forces_every = 0
+    sim.initialize()
+
+    # rigid-motion velocity blobs around each disk (established
+    # seeding pattern: sync then rewrite the slot fields)
+    sim.sync_fields()
+    f = sim.forest
+    order = f.order()
+    bs = cfg.bs
+    h = f.h_per_block(order)
+    ar = np.arange(bs) + 0.5
+    xc = (f.bi[order].astype(np.float64) * bs * h)[:, None, None] \
+        + ar[None, None, :] * h[:, None, None]
+    yc = (f.bj[order].astype(np.float64) * bs * h)[:, None, None] \
+        + ar[None, :, None] * h[:, None, None]
+    vel = np.array(f.fields["vel"])
+    u0 = 0.6
+    blob = np.zeros((len(order), bs, bs))
+    for (cx, cy, uu) in ((0.42, 0.5, u0), (0.58, 0.5, -u0)):
+        rr2 = (xc - cx) ** 2 + (yc - cy) ** 2
+        blob += uu * np.exp(-rr2 / (2.0 * (1.0 * r) ** 2))
+    vel[order, 0] = blob
+    vel[order, 1] = 0.0
+    f.fields["vel"] = jnp.asarray(vel)
+    return sim
+
+
+def run_trajectory():
+    sim = build_sim()
+    rec = {"steps": []}
+    for _ in range(N_STEPS):
+        # fixed dt: the CFL dt balloons as the blobs decay, and a
+        # pinned trajectory should not owe its step times to umax noise
+        sim.step_once(dt=0.008)
+        rec["steps"].append({
+            "time": float(sim.time),
+            "bodies": [
+                {"com": [float(s.com[0]), float(s.com[1])],
+                 "u": float(s.u), "v": float(s.v),
+                 "omega": float(s.omega)}
+                for s in sim.shapes
+            ],
+        })
+    # the run must contain a real collision: the pair approaches
+    # (du = u1 - u0 < 0 while closing) and then rebounds (du > 0)
+    du = [st["bodies"][1]["u"] - st["bodies"][0]["u"]
+          for st in rec["steps"]]
+    gap = [st["bodies"][1]["com"][0] - st["bodies"][0]["com"][0]
+           for st in rec["steps"]]
+    assert min(du) < -0.05, f"bodies never approached: {du}"
+    assert max(du[du.index(min(du)):]) > 0.0, \
+        f"no rebound after closest approach: {du}"
+    assert min(gap) < gap[0], "gap never closed"
+    rec["min_gap"] = min(gap)
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write", action="store_true")
+    args = ap.parse_args()
+    rec = run_trajectory()
+    print(json.dumps(rec, indent=1))
+    if args.write:
+        with open(GOLDEN_PATH, "w") as f:
+            json.dump(rec, f, indent=1, sort_keys=True)
+        print(f"wrote {GOLDEN_PATH}")
+
+
+if __name__ == "__main__":
+    main()

@@ -43,7 +43,8 @@ def capture_uniform(size: int, trace_dir: str, reps: int):
     grid = UniformGrid(cfg, level=level)
     state = bench_state(grid)
     dt = jnp.asarray(1e-4, grid.dtype)
-    step = jax.jit(lambda s: grid.step(s, dt)[0])
+    step = jax.jit(lambda s: grid.step(s, dt, obstacle_terms=False)[0],
+                   donate_argnums=(0,))
     # warm until the deltap initial guess coasts (bench.py's production
     # regime: ~0.5 Poisson iterations/step) so the trace shows the
     # steady-state composition, not a cold pressure solve
