@@ -21,6 +21,43 @@ import pickle
 
 import numpy as np
 
+
+# ---------------------------------------------------------------------------
+# multi-host (pod) support: the reference dumps collectively from every
+# rank via MPI-IO (main.cpp:3367-3467, MPI_File_write_at_all with
+# MPI_Exscan offsets; the XDMF index written by the last rank). The pod
+# equivalent here: every process joins ONE gather collective that
+# replicates the sharded field on hosts, then process 0 alone writes
+# the files (which must live on shared storage, the same assumption
+# MPI-IO makes). Single-host runs take the plain np.asarray path.
+# ---------------------------------------------------------------------------
+
+def _multihost() -> bool:
+    import jax
+    return jax.process_count() > 1
+
+
+def _to_host_global(x) -> np.ndarray:
+    """Full host copy of a (possibly cross-host-sharded) array. A
+    COLLECTIVE on pods: every process must call it, in the same order."""
+    if _multihost():
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
+def _is_writer() -> bool:
+    import jax
+    return jax.process_index() == 0
+
+
+def _sync_processes(tag: str) -> None:
+    """Barrier so non-writer processes cannot race past an incomplete
+    checkpoint/dump (no-op single-host)."""
+    if _multihost():
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
+
 _XDMF_TEMPLATE = """<Xdmf
     Version="2.0">
   <Domain>
@@ -103,7 +140,15 @@ def dump_forest(path: str, time: float, forest, order=None) -> None:
     order = forest.order() if order is None else order
     bs = forest.bs
     n = len(order)
-    vel = np.asarray(forest.fields["vel"][order], dtype=np.float64)
+    # collective on pods (every process calls; process 0 writes below).
+    # The [order] gather runs on DEVICE before the host transfer —
+    # identical order arrays on every process keep it SPMD-valid, and
+    # the host only ever sees the active blocks, not the padded bucket.
+    vel = _to_host_global(forest.fields["vel"][np.asarray(order)])
+    if not _is_writer():
+        _sync_processes("dump_forest")
+        return
+    vel = vel.astype(np.float64)
 
     h = forest.cfg.h0 / (1 << forest.level[order]).astype(np.float64)
     ar = np.arange(bs, dtype=np.float64)
@@ -117,6 +162,7 @@ def dump_forest(path: str, time: float, forest, order=None) -> None:
     x1 = xg + h[:, None, None]
     y1 = yg + h[:, None, None]
     _write_quads(path, time, xg, yg, x1, y1, vel[:, 0], vel[:, 1])
+    _sync_processes("dump_forest")
 
 
 def read_dump(path: str):
@@ -140,16 +186,17 @@ def save_checkpoint(dirpath: str, sim) -> None:
 
     Written to a sibling temp dir and renamed into place so a crash
     mid-save (the very event checkpointing exists for) can't destroy the
-    previous restart point."""
-    tmp = dirpath.rstrip("/") + ".tmp"
-    if os.path.exists(tmp):
-        import shutil
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
+    previous restart point. On a multi-host pod this is a COLLECTIVE:
+    every process must call it (the field gathers are all-gathers);
+    process 0 alone writes, to storage all processes can read back
+    (the reference's MPI-IO dump makes the same shared-FS assumption),
+    and a barrier keeps the others from racing past an incomplete
+    save."""
     if hasattr(sim, "sync_fields"):
         # the adaptive driver's per-step truth is its ordered working
         # state; flush it into the slot-layout dict read below
         sim.sync_fields()
+    # collectives FIRST, identical order on every process
     if hasattr(sim, "forest"):
         # adaptive: topology as (level, i, j) keys + fields in SFC order
         # (slot numbering is an allocator detail that need not survive)
@@ -157,12 +204,25 @@ def save_checkpoint(dirpath: str, sim) -> None:
         order = f.order()
         keys = np.stack([f.level[order], f.bi[order], f.bj[order]],
                         axis=1).astype(np.int32)
-        fields = {k: np.asarray(v[order]) for k, v in f.fields.items()}
-        np.savez(os.path.join(tmp, "fields.npz"),
-                 __forest_keys=keys, **fields)
+        # device-side [order] gather before the host transfer (active
+        # blocks only; identical order on all processes keeps the
+        # collective valid)
+        oj = np.asarray(order)
+        fields = {k: _to_host_global(v[oj])
+                  for k, v in sorted(f.fields.items())}
+        payload = {"__forest_keys": keys, **fields}
     else:
-        fields = {k: np.asarray(v) for k, v in sim.state._asdict().items()}
-        np.savez(os.path.join(tmp, "fields.npz"), **fields)
+        payload = {k: _to_host_global(v)
+                   for k, v in sim.state._asdict().items()}
+    if not _is_writer():
+        _sync_processes("save_checkpoint")
+        return
+    tmp = dirpath.rstrip("/") + ".tmp"
+    if os.path.exists(tmp):
+        import shutil
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    np.savez(os.path.join(tmp, "fields.npz"), **payload)
     shapes = getattr(sim, "shapes", [])
     with open(os.path.join(tmp, "shapes.pkl"), "wb") as f:
         pickle.dump(shapes, f)
@@ -207,6 +267,7 @@ def save_checkpoint(dirpath: str, sim) -> None:
     os.replace(tmp, dirpath)
     if os.path.exists(old):
         shutil.rmtree(old)
+    _sync_processes("save_checkpoint")
 
 
 def load_checkpoint(dirpath: str, sim) -> None:

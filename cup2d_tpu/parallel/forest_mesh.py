@@ -33,6 +33,14 @@ from ..amr import AMRSim
 from ..config import SimConfig
 
 
+def _exchange_mode() -> str:
+    """ONE selection point for the surface-exchange mode so the halo
+    gather and the flux-correction deposit exchange can never be built
+    in different modes for the same sim (code-review r4)."""
+    import os
+    return os.environ.get("CUP2D_SHARD_EXCHANGE", "ppermute")
+
+
 class ShardedAMRSim(AMRSim):
     """AMRSim whose block axis is sharded over a device mesh.
 
@@ -88,8 +96,7 @@ class ShardedAMRSim(AMRSim):
         padded = {k: pad_tables(raw[k], n_pad)
                   for k in ("vec1t", "sca1t") if k in raw}
         out = dict(jax.device_put(padded, repl))
-        import os
-        mode = os.environ.get("CUP2D_SHARD_EXCHANGE", "ppermute")
+        mode = _exchange_mode()
         for k, t in raw.items():
             if k not in padded:
                 out[k] = shard_tables(t, n_pad, self.mesh, mode=mode)
@@ -100,12 +107,11 @@ class ShardedAMRSim(AMRSim):
         from .shard_halo import shard_flux_corr
         if n_pad % self.mesh.devices.size:
             return super()._finalize_corr(topo, n_pad)
-        import os
         raw = build_flux_corr(self.forest, self._order, topo=topo)
         return shard_flux_corr(
             raw, n_pad, self.mesh, self.cfg.bs,
             dtype=np.dtype(self.forest.dtype),
-            mode=os.environ.get("CUP2D_SHARD_EXCHANGE", "ppermute"))
+            mode=_exchange_mode())
 
     def _window_raster(self, inp, N):
         """Window rasterization with a shard-local scatter: every device

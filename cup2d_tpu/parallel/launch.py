@@ -43,10 +43,16 @@ construction:
 
 `tests/test_multihost.py` enforces this with two real jax.distributed
 processes: three regrid+step cycles must produce identical topology +
-gather-table digests on both. Known multi-host gaps (single-host-only
-conveniences, not correctness hazards): dumps/checkpoints np.asarray
-fully-sharded fields and therefore need a process-0 gather step on a
-real pod.
+gather-table digests on both, then the run writes a dump and a
+checkpoint, restores, and continues identically.
+
+Pod-safe I/O (io.py, the reference's collective MPI-IO dump
+main.cpp:3367-3467): dump_forest/save_checkpoint are COLLECTIVE on
+pods — every process joins one field all-gather, process 0 alone
+writes (to shared storage, MPI-IO's own assumption), and a barrier
+keeps the others from racing past an incomplete save. load_checkpoint
+reads the same bytes on every process; everything downstream is the
+deterministic replicated-host machinery above.
 """
 
 from __future__ import annotations
