@@ -173,6 +173,16 @@ class AMRSim(ShapeHostMixin):
         self._next_dt_version = -1
         self._next_umax = None   # survives regrids (see step_once)
         self._next_umax_version = -1
+        # production two-level trigger (VERDICT r3 #9): when the last
+        # production solve burned > 15 iterations (block-Jacobi's
+        # block-count scaling law on near-uniform forests — ~200/step
+        # at 1e4 blocks, r4 scale trace), engage the coarse correction
+        # and keep it until the next topology change. _last_iters rides
+        # host pulls that already happen (the megastep scalar pull /
+        # the obstacle-free dt float) — no extra round trip.
+        self._last_iters = 0
+        self._last_iters_dev = None
+        self._coarse_on = False
         self._raster_jit = jax.jit(self._rasterize_impl)
         self._vorticity_jit = jax.jit(self._vorticity_impl)
         self._tags_jit = jax.jit(self._tags_impl)
@@ -277,14 +287,17 @@ class AMRSim(ShapeHostMixin):
             self._tables = self._finalize_tables(raw, n_pad)
         with tm.phase("tables/corr"):
             self._corr = self._finalize_corr(topo, n_pad)
-        # exact-mode two-level preconditioner maps: every cell's coarse
-        # cell on the uniform level-c grid + its area weight (cells
-        # coarser than c deposit into the coarse cell under their
-        # center — approximate, but it is only a preconditioner). Built
+        # two-level preconditioner maps: every cell's coarse cell on
+        # the uniform level-c grid + its area weight (cells coarser
+        # than c deposit into the coarse cell under their center —
+        # approximate, but it is only a preconditioner). Built
         # vectorized and passed through the jit boundary as arguments.
-        # Only the first-10-steps exact solves consume them, so builds
-        # stop once production mode is reached (the [cells, 4] arrays
-        # are ~50 MB at 1e4-block pads — dead regrid latency otherwise).
+        # Startup (steps < 10) always consumes them; production builds
+        # them LAZILY on the iters>15 trigger (_use_coarse) — the
+        # [cells, 4] arrays are ~50 MB at 1e4-block pads, dead regrid
+        # latency for the compressed forests that never trigger.
+        # Topology changed: the trigger re-arms from scratch.
+        self._coarse_on = False
         if self.step_count >= 10:
             self._coarse_cw = None
         else:
@@ -527,16 +540,20 @@ class AMRSim(ShapeHostMixin):
         def M(r):
             return apply_block_precond_blocks(r, self.p_inv)
 
-        if exact_poisson and tcoarse is not None:
-            # two-level preconditioner for the cold startup solves
-            # (VERDICT r2 #6): block-Jacobi leaves the global pressure
-            # modes to the Krylov iteration (hundreds of iterations on a
-            # cold RHS); a coarse uniform-grid correction (FFT-exact
-            # Neumann solve, poisson.coarse_neumann_solve) deflates them
-            # multiplicatively. Production steps keep plain block-Jacobi
-            # — their warm deltap guess needs only 2-5 iterations and
-            # the extra A-apply per application would cost more than it
-            # saves.
+        if tcoarse is not None:
+            # two-level preconditioner (VERDICT r2 #6): block-Jacobi
+            # leaves the global pressure modes to the Krylov iteration
+            # (hundreds of iterations on a cold RHS); a coarse
+            # uniform-grid correction (FFT-exact Neumann solve,
+            # poisson.coarse_neumann_solve) deflates them
+            # multiplicatively. Used for the cold startup solves and,
+            # since round 4, for PRODUCTION solves behind the driver's
+            # iters>15 trigger (step_once): on strongly compressed
+            # forests the warm deltap guess needs 2-5 block-Jacobi
+            # iterations and the extra A-apply per application would
+            # cost more than it saves — but at >= 1e4 near-uniform
+            # blocks the same solve runs ~200 iterations (r4 scale
+            # trace), the uniform path's block-Jacobi scaling law.
             pidx, pw, wdep = tcoarse
             ncy, ncx = self._coarse_shape
             cih2 = jnp.where(hsq > 0,
@@ -553,20 +570,36 @@ class AMRSim(ShapeHostMixin):
                 return e + apply_block_precond_blocks(
                     r - A(e), self.p_inv)
 
-        # exact mode runs at tol 0 and terminates through the solver's
-        # own stall detector at the precision floor — no grid-dependent
-        # magic constants (the r2 builds hardcoded rel 1e-4 here,
-        # VERDICT r2 #8); the tighter refresh cadence makes the stall
-        # exit decisive within ~2 windows of reaching the floor
+        # the cold startup solves start from x0 = M(b): one two-level
+        # application removes the global pressure modes from r0 before
+        # the Krylov iteration begins — the zero-pressure first solve
+        # was the 71-iteration outlier of the round-3 probe precisely
+        # because those modes dominated its RHS (VERDICT r3 #9)
+        x0 = None
+        if exact_poisson and tcoarse is not None:
+            x0 = M(b)
+        # exact mode converges THREE ORDERS past the case's own
+        # production target (max(1e-3*tol, 1e-3*tol_rel*|r0|)) — deep
+        # enough that the startup pressure transient is converged for
+        # any consumer of the production tolerances, and anchored to
+        # the case instead of the r2 builds' grid-dependent empirical
+        # f32 floors (VERDICT r2 #8). The stall detector remains the
+        # backstop when that target sits below the precision floor.
+        # Chasing the literal-0 floor instead spent up to 71 iterations
+        # grinding to 1e-8 on the first canonical solve (r3 probe) for
+        # depth nothing reads; this exits at <= 40 (measured).
         res = bicgstab(
-            A, b, M=M,
-            tol=0.0 if exact_poisson else cfg.poisson_tol,
-            tol_rel=0.0 if exact_poisson else cfg.poisson_tol_rel,
+            A, b, M=M, x0=x0,
+            tol=1e-3 * cfg.poisson_tol if exact_poisson
+            else cfg.poisson_tol,
+            tol_rel=1e-3 * cfg.poisson_tol_rel if exact_poisson
+            else cfg.poisson_tol_rel,
             max_iter=cfg.max_poisson_iterations,
             max_restarts=100 if exact_poisson else cfg.max_poisson_restarts,
             sum_dtype=self.sum_dtype,
             refresh_every=10 if exact_poisson else 50,
-            stall_iters=20 if exact_poisson else 120,
+            stall_iters=15 if exact_poisson else 120,
+            stall_rtol=0.99 if exact_poisson else 0.999,
         )
 
         # volume-weighted mean removal (main.cpp:7120-7173)
@@ -1121,10 +1154,42 @@ class AMRSim(ShapeHostMixin):
             self.forest.dtype)
 
     def compute_dt(self) -> float:
-        # masked: ordered pad rows carry stale (finite) data
+        # masked: ordered pad rows carry stale (finite) data.
+        # _float_pull (not float): this is the obstacle-free dt
+        # fallback after external field writes — a plain float() here
+        # would discard the pending poisson-iters scalar and disarm
+        # the two-level trigger exactly on such drivers (code-review r4)
         umax = jnp.max(jnp.abs(
             self._ordered_state()["vel"]) * self._maskv)
-        return float(self._dt_from_umax(umax, self._hmin()))
+        return self._float_pull(self._dt_from_umax(umax, self._hmin()))
+
+    def _use_coarse(self, exact: bool):
+        """Coarse-correction operand for the next solve: always for the
+        startup (exact) solves; for production, engaged when the last
+        solve burned > 15 iterations and sticky until the next topology
+        change (block-Jacobi alone follows the uniform path's
+        block-count scaling law on near-uniform forests — ~200
+        iterations/step at 1e4 blocks, BASELINE.md r4 scale trace).
+        Maps build lazily on first engagement."""
+        if not exact:
+            if not self._coarse_on and self._last_iters > 15:
+                self._coarse_on = True
+            if not self._coarse_on:
+                return None
+        if self._coarse_cw is None:
+            self._build_coarse_maps(self._npad_hwm, self._n_real)
+        return self._coarse_cw
+
+    def _float_pull(self, x) -> float:
+        """float(x) that also drains the pending poisson-iters scalar
+        in the SAME host transfer (the trigger must not add a tunnel
+        round trip to the obstacle-free step)."""
+        if self._last_iters_dev is not None:
+            v, it = jax.device_get((x, self._last_iters_dev))
+            self._last_iters = int(it)
+            self._last_iters_dev = None
+            return float(v)
+        return float(x)
 
     def step_once(self, dt: Optional[float] = None):
         self._refresh()
@@ -1146,11 +1211,14 @@ class AMRSim(ShapeHostMixin):
                         # guard as the obstacle path (ADVICE r2)
                         fac = (1.0 if self._next_umax_version
                                == f.version else 1.05)
-                        dt = float(self._dt_from_umax(
+                        dt = self._float_pull(self._dt_from_umax(
                             fac * jnp.asarray(self._next_umax, f.dtype),
                             self._hmin()))
                     else:
                         dt = self.compute_dt()
+            elif self._last_iters_dev is not None:
+                # explicit-dt callers still drain the iters scalar
+                self._float_pull(jnp.zeros((), f.dtype))
             exact = self.step_count < 10
             with tm.phase("flow"):
                 vel, pres, diag = self._step_jit(
@@ -1159,7 +1227,7 @@ class AMRSim(ShapeHostMixin):
                     self._h, self._hsq_flat, self._maskv,
                     self._tables["vec3"], self._tables["vec1"],
                     self._tables["sca1"], self._tables["pois"],
-                    self._corr, self._coarse_cw if exact else None,
+                    self._corr, self._use_coarse(exact),
                     exact_poisson=exact)
                 self._set_ordered(vel=vel, pres=pres)
                 # end-state umax stays a DEVICE scalar — the next
@@ -1167,6 +1235,13 @@ class AMRSim(ShapeHostMixin):
                 # reduction, and only its one-scalar pull touches host
                 self._next_umax = diag["umax"]
                 self._next_umax_version = f.version
+                if not exact:
+                    # iters ride the NEXT dt pull (see _float_pull).
+                    # Exact-startup counts are excluded: they converge
+                    # 3 orders deeper with a different M, and would
+                    # spuriously trip the production trigger on
+                    # compressed forests (code-review r4)
+                    self._last_iters_dev = diag["poisson_iters"]
                 if self.timers is not None:
                     jax.block_until_ready(vel)  # charge flow to "flow"
             self.time += dt
@@ -1237,7 +1312,7 @@ class AMRSim(ShapeHostMixin):
                 self._tables["vec3"], self._tables["vec1"],
                 self._tables["sca1"], self._tables["pois"],
                 self._tables.get("vec4t"), self._tables.get("sca4t"),
-                self._corr, self._coarse_cw if exact else None,
+                self._corr, self._use_coarse(exact),
                 exact_poisson=exact,
                 with_forces=with_forces)
             self._set_ordered(vel=vel, pres=pres, chi=chi_new)
@@ -1253,6 +1328,11 @@ class AMRSim(ShapeHostMixin):
         self._next_dt_version = f.version
         self._next_umax = float(diag["umax"])
         self._next_umax_version = f.version
+        if not exact:
+            # the megastep's single pull already carried the iteration
+            # count — feed the production two-level trigger directly
+            # (exact-startup counts excluded, see the step_jit path)
+            self._last_iters = int(diag["poisson_iters"])
         if with_forces:
             with tm.phase("forces"):
                 self._record_forces(forces)

@@ -244,6 +244,7 @@ def bicgstab(
     sum_dtype=None,
     refresh_every: int = 50,
     stall_iters: int = 120,
+    stall_rtol: float = 0.999,
 ) -> BiCGSTABResult:
     """Preconditioned flexible BiCGSTAB, whole loop jitted on device.
 
@@ -375,8 +376,14 @@ def bicgstab(
         # like-for-like. Comparing per-iteration recursive norms against
         # a refresh-corrected history would latch a drifted-low floor
         # that true residuals can never beat, firing mid-convergence.
+        # stall_rtol sets what counts as progress: 0.999 (production)
+        # keeps grinding for any 0.1%/window gain; exact mode passes
+        # 0.99 so windows improving < 1% stop the solve — a
+        # diminishing-returns cut that trims the tol-0 startup tail
+        # (71 -> ~40 iterations on the canonical probe) at the cost of
+        # one order of residual depth nobody consumes
         l2_now = jnp.sqrt(dot(r, r))
-        improved = refresh & (l2_now < 0.999 * s.best_l2)
+        improved = refresh & (l2_now < stall_rtol * s.best_l2)
         best_l2 = jnp.where(refresh, jnp.minimum(s.best_l2, l2_now),
                             s.best_l2)
         impr_it = jnp.where(improved, s.it, s.impr_it)

@@ -205,6 +205,7 @@ class UniformGrid:
             sum_dtype=self.sum_dtype,
             refresh_every=10 if exact else 50,
             stall_iters=20 if exact else 120,
+            stall_rtol=0.99 if exact else 0.999,
         )
 
     # -- step stages, shared by the obstacle-free and Simulation paths --

@@ -56,7 +56,8 @@ def main():
         for name in sorted(sim._tables):
             t = sim._tables[name]
             if hasattr(t, "pack"):      # ShardTables
-                for leaf in (t.pack, t.src, t.dest_s, t.dest):
+                for leaf in (t.pack, t.src_l, t.dest_sl, t.dest_l,
+                             t.src_r, t.dest_sr, t.dest_r):
                     h.update(np.asarray(
                         sim._pull_blockwise(leaf)).tobytes())
             else:                        # replicated HaloTables

@@ -108,6 +108,31 @@ def test_per_step_comm_is_boundary_proportional():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_exchange_has_overlappable_local_work():
+    """Comm/compute overlap as STRUCTURE (VERDICT r3 #6): in the
+    compiled megastep, every surface collective must leave substantial
+    dependence-independent work (the local-only ghost rows + lab init)
+    that a latency-hiding scheduler can run while the exchange is in
+    flight — and the majority of ghost rows must be local-only."""
+    from validation.overlap_check import analyze, row_split
+
+    cfg, sim = _build_sim()
+    txt = _capture(sim, "_mega_jit", lambda: sim.step_once(dt=1e-3))
+    pairs = analyze(txt)
+    assert pairs, "no collectives found in the megastep"
+    # every exchange has at least 10x its own volume of independent
+    # work available to hide behind
+    for p in pairs:
+        assert (p["independent_elems_total"]
+                >= 10 * p["elems_exchanged"]), p
+    # and the split itself: most ghost rows never touch the exchange
+    split = row_split(sim._tables)
+    assert split
+    for name, s in split.items():
+        assert s["local_rows"] > s["remote_rows"], (name, s)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
 def test_surface_bucket_tracks_shard_boundary():
     """The exchanged surface bucket S must be bounded by the GEOMETRIC
     shard boundary (blocks whose 3x3 spatial neighborhood, at same /

@@ -26,12 +26,11 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_amr_determinism():
-    import tempfile
+def test_two_process_amr_determinism(tmp_path):
     port = _free_port()
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     worker = os.path.join(root, "tests", "_multihost_worker.py")
-    outdir = tempfile.mkdtemp(prefix="cup2d_mh_io_")
+    outdir = str(tmp_path)     # pytest-managed: auto-cleaned
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)           # worker sets its own count
     env["PYTHONPATH"] = root

@@ -1,0 +1,199 @@
+"""Comm/compute overlap evidence from the compiled schedule (VERDICT r3
+missing #3 / next #6).
+
+The sharded lab assembly issues its surface exchange first and scatters
+every local-only ghost row before touching the received buffer
+(parallel/shard_halo.py). This tool compiles the real megastep on the
+8-virtual-device mesh and inspects the optimized module's instruction
+stream: for every async collective start/done pair it counts the
+non-trivial compute ops (fusions/gathers/scatters and their element
+totals) that the dependence structure places BETWEEN start and done —
+work the scheduler is free to (and on TPU's latency-hiding scheduler,
+does) run while the exchange is in flight. It also reports the
+local/remote row split, i.e. what fraction of the ghost assembly is
+exchange-independent.
+
+    python -m validation.overlap_check [--devices 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+
+def build_and_lower(n_dev: int):
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_dev}"
+        ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.models import DiskShape
+    from cup2d_tpu.parallel.forest_mesh import ShardedAMRSim
+    from cup2d_tpu.parallel.mesh import make_mesh
+
+    cfg = SimConfig(bpdx=2, bpdy=1, level_max=3, level_start=1,
+                    extent=1.0, dtype="float32", nu=4e-5, lam=1e6,
+                    rtol=2.0, ctol=1.0)
+    mesh = make_mesh(n_dev)
+    sim = ShardedAMRSim(cfg, mesh, shapes=[DiskShape(0.08, 0.55, 0.25)])
+    sim.compute_forces_every = 0
+    sim.initialize()
+
+    captured = {}
+    orig = sim._mega_jit
+
+    def wrapper(*a, **k):
+        captured["a"], captured["k"] = a, k
+        return orig(*a, **k)
+
+    sim._mega_jit = wrapper
+    sim.step_once(dt=1e-3)
+    txt = orig.lower(*captured["a"], **captured["k"]).compile().as_text()
+
+    return txt, row_split(sim._tables)
+
+
+def row_split(tables) -> dict:
+    """Real (non-padded) local/remote ghost-row counts per sharded
+    table set — the ONE definition of the B*L*L scratch-slot
+    convention, shared with tests/test_comm_volume.py."""
+    import numpy as np
+    split = {}
+    for name, t in tables.items():
+        if hasattr(t, "src_l"):
+            scr = t.B * t.L * t.L
+            n_l = int((np.asarray(t.dest_sl) < scr).sum()
+                      + (np.asarray(t.dest_l) < scr).sum())
+            n_r = int((np.asarray(t.dest_sr) < scr).sum()
+                      + (np.asarray(t.dest_r) < scr).sum())
+            split[name] = {"local_rows": n_l, "remote_rows": n_r}
+    return split
+
+
+_INSTR = re.compile(r"^\s+(?:ROOT )?%([\w.\-]+) = ([a-z0-9]+)\[([0-9,]*)\][^ ]* (\S+)\((.*)$")
+_OPND = re.compile(r"%([\w.\-]+)")
+_WORK_OPS = ("fusion", "gather", "scatter", "dynamic-update-slice",
+             "concatenate", "copy", "transpose", "reduce")
+
+
+def analyze(txt: str) -> list[dict]:
+    """Dependence-graph overlap evidence per collective.
+
+    The CPU backend (the only multi-device backend available here)
+    lowers collectives SYNCHRONOUSLY — no start/done pairs exist to
+    inspect. The schedulable-overlap property is still decidable from
+    the dependence graph: for each collective-permute/all-gather, every
+    op that sits between its issue point and its FIRST consumer in
+    program order and is neither an ancestor nor a descendant of the
+    collective is work a latency-hiding scheduler (TPU's) may run while
+    the exchange is in flight. Reported per collective with element
+    volumes."""
+    out = []
+    for comp in txt.split("\n\n"):
+        lines = comp.splitlines()
+        instrs = []          # (name, op, dims, operands, line_idx)
+        by_name = {}
+        for i, ln in enumerate(lines):
+            m = _INSTR.match(ln)
+            if not m:
+                continue
+            name, dt_, dims, op = m.group(1), m.group(2), m.group(3), \
+                m.group(4)
+            opnds = _OPND.findall(m.group(5))
+            dims_l = [int(x) for x in dims.split(",") if x]
+            n = 1
+            for d_ in dims_l:
+                n *= d_
+            by_name[name] = len(instrs)
+            instrs.append((name, op, n, opnds))
+        colls = [k for k, (nm, op, _, _) in enumerate(instrs)
+                 if op in ("collective-permute", "all-gather",
+                           "collective-permute-start",
+                           "all-gather-start")]
+        if not colls:
+            continue
+        # descendants per collective (transitive users)
+        users: list[list[int]] = [[] for _ in instrs]
+        for k, (_, _, _, opnds) in enumerate(instrs):
+            for o in opnds:
+                j = by_name.get(o)
+                if j is not None:
+                    users[j].append(k)
+        for c in colls:
+            desc = set()
+            stack = [c]
+            while stack:
+                k = stack.pop()
+                for u in users[k]:
+                    if u not in desc:
+                        desc.add(u)
+                        stack.append(u)
+            anc = set()
+            stack = [c]
+            while stack:
+                k = stack.pop()
+                for o in instrs[k][3]:
+                    j = by_name.get(o)
+                    if j is not None and j not in anc:
+                        anc.add(j)
+                        stack.append(j)
+            first_use = min((d for d in desc), default=len(instrs))
+            free_ops = 0
+            free_elems = 0
+            indep_ops = 0
+            indep_elems = 0
+            for k in range(len(instrs)):
+                if k == c or k in desc or k in anc:
+                    continue
+                nm, op, n, _ = instrs[k]
+                if op not in _WORK_OPS:
+                    continue
+                indep_ops += 1
+                indep_elems += n
+                if c < k < first_use:
+                    free_ops += 1
+                    free_elems += n
+            out.append({
+                "collective": instrs[c][1],
+                "elems_exchanged": instrs[c][2],
+                # textual window (what the CPU emitter already placed
+                # between issue and first consumer)
+                "independent_ops_before_first_consumer": free_ops,
+                "independent_elems_before_first_consumer": free_elems,
+                # dependence-graph bound (what a latency-hiding
+                # scheduler — TPU's — may move into the window)
+                "independent_ops_total": indep_ops,
+                "independent_elems_total": indep_elems,
+            })
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    args = ap.parse_args()
+    txt, split = build_and_lower(args.devices)
+    pairs = analyze(txt)
+    overlapped = [
+        p for p in pairs
+        if p["independent_ops_before_first_consumer"] > 0]
+    print(json.dumps({
+        "n_collectives": len(pairs),
+        "n_with_overlappable_work": len(overlapped),
+        "pairs": pairs[:24],
+        "row_split": split,
+    }, indent=1))
+    return 0 if overlapped else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
