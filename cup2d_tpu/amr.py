@@ -296,8 +296,13 @@ class AMRSim(ShapeHostMixin):
         # them LAZILY on the iters>15 trigger (_use_coarse) — the
         # [cells, 4] arrays are ~50 MB at 1e4-block pads, dead regrid
         # latency for the compressed forests that never trigger.
-        # Topology changed: the trigger re-arms from scratch.
+        # Topology changed: the trigger re-arms from scratch — including
+        # the iteration-count evidence, which described the OLD forest
+        # (a stale 400-iteration count from a pre-compression topology
+        # must not engage the correction on the new one)
         self._coarse_on = False
+        self._last_iters = 0
+        self._last_iters_dev = None
         if self.step_count >= 10:
             self._coarse_cw = None
         else:

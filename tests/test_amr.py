@@ -511,7 +511,10 @@ def test_production_two_level_trigger():
     assert sim._coarse_on
     assert sim._last_iters == n1
     assert sim._coarse_cw is not None
-    # topology change re-arms the trigger
+    # topology change re-arms the trigger INCLUDING the stale count
+    # (a pre-regrid 400-iteration count must not engage the correction
+    # on the new topology)
     sim.forest.version += 1
     sim._refresh()
     assert not sim._coarse_on
+    assert sim._last_iters == 0 and sim._last_iters_dev is None

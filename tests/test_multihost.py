@@ -61,6 +61,13 @@ def test_two_process_amr_determinism(tmp_path):
         iohashes.append(
             [ln for ln in out.splitlines() if ln.startswith("IOHASH")])
         assert "DONE" in out
+        bucket = [ln for ln in out.splitlines()
+                  if ln.startswith("BUCKET")]
+        assert bucket, out
+    # the hard case's bucket line must also agree across processes
+    assert ([ln for ln in outs[0].splitlines() if ln.startswith("BUCKET")]
+            == [ln for ln in outs[1].splitlines()
+                if ln.startswith("BUCKET")])
     assert digests[0] == digests[1], (
         "processes diverged:\n" + "\n".join(
             f"{a}   vs   {b}" for a, b in zip(*digests)))

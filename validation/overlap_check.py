@@ -80,6 +80,12 @@ def row_split(tables) -> dict:
 
 
 _INSTR = re.compile(r"^\s+(?:ROOT )?%([\w.\-]+) = ([a-z0-9]+)\[([0-9,]*)\][^ ]* (\S+)\((.*)$")
+# tuple-shaped instructions (async collective starts on TPU lower as
+# '(f32[256], u32[], ...) collective-permute-start(...)'): capture the
+# FIRST element's dtype/dims as the payload shape
+_INSTR_TUPLE = re.compile(
+    r"^\s+(?:ROOT )?%([\w.\-]+) = \(([a-z0-9]+)\[([0-9,]*)\][^)]*\) "
+    r"(\S+)\((.*)$")
 _OPND = re.compile(r"%([\w.\-]+)")
 _WORK_OPS = ("fusion", "gather", "scatter", "dynamic-update-slice",
              "concatenate", "copy", "transpose", "reduce")
@@ -103,7 +109,7 @@ def analyze(txt: str) -> list[dict]:
         instrs = []          # (name, op, dims, operands, line_idx)
         by_name = {}
         for i, ln in enumerate(lines):
-            m = _INSTR.match(ln)
+            m = _INSTR.match(ln) or _INSTR_TUPLE.match(ln)
             if not m:
                 continue
             name, dt_, dims, op = m.group(1), m.group(2), m.group(3), \
