@@ -109,7 +109,10 @@ def _pick(n: int, pref) -> int:
 @functools.partial(jax.jit, static_argnames=("ny", "nx"))
 def _advect_call(vlab_aligned, facs, ny, nx):
     by = _pick(ny, (32, 16, 8))
-    bx = _pick(nx, (2048, 1024, 512, 256, 128))
+    # 1024 cap: the round-4 selection-form WENO (2 recons built from
+    # 10 selects) carries more live VMEM temporaries than the r2 form;
+    # 2048-wide chunks exceeded the 16M scoped-vmem limit by ~3M
+    bx = _pick(nx, (1024, 512, 256, 128))
     nch = nx // bx
     kernel = functools.partial(_adv_kernel, by, bx, nch)
     return pl.pallas_call(
@@ -141,7 +144,7 @@ def advect_supported(ny: int, nx: int) -> bool:
     except Exception:
         return False
     return bool(_pick(ny, (32, 16, 8))) and bool(
-        _pick(nx, (2048, 1024, 512, 256, 128)))
+        _pick(nx, (1024, 512, 256, 128)))
 
 
 def advect_diffuse_rhs_pallas(vlab, h, nu, dt, nx):

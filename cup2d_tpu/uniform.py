@@ -56,17 +56,29 @@ def pad_scalar(p: jnp.ndarray, g: int) -> jnp.ndarray:
 
 def pad_vector(v: jnp.ndarray, g: int) -> jnp.ndarray:
     """[..., 2, Ny, Nx] -> [..., 2, Ny+2g, Nx+2g], free-slip mirror
-    (VectorLab::applyBCface): u flips sign in x-ghost columns, v flips in
-    y-ghost rows; corners compose both flips — exactly the reference's
-    two-pass face sweep. Sign flips touch only the g-wide ghost STRIPS
-    (in-place slice updates) instead of a whole-array multiply+stack —
-    the latter cost two extra full-field passes per lab (~6.6 ms/step at
-    8192^2 in the round-3 trace)."""
-    out = pad_scalar(v, g)
-    out = out.at[..., 0, :, :g].multiply(-1.0)
-    out = out.at[..., 0, :, -g:].multiply(-1.0)
-    out = out.at[..., 1, :g, :].multiply(-1.0)
-    out = out.at[..., 1, -g:, :].multiply(-1.0)
+    (VectorLab::applyBCface): u flips sign in x-ghost columns, v flips
+    in y-ghost rows; corners compose both flips — exactly the
+    reference's two-pass face sweep. Built as a ZERO pad (a fusible pad
+    HLO) plus ghost-strip writes of the sign-flipped edge lines: the
+    edge-mode pad + per-component strip multiplies this replaces cost
+    4.8x more standalone at 8192^2/g=3 (75 -> 16 ms — each integer-
+    indexed strip update materialized a full copy)."""
+    pad = [(0, 0)] * (v.ndim - 2) + [(g, g), (g, g)]
+    out = jnp.pad(v, pad)
+    # per-component SLICE-indexed strip writes: integer component
+    # indices materialize full copies, and a [2]-element sign-vector
+    # constant costs a ~0.09 ms DMA staging per use on this chip
+    # (3.5 ms/step traced) — the negation belongs in the expression.
+    # y-ghosts copy u, flip v; x-ghosts flip u, copy v.
+    out = out.at[..., 0:1, :g, g:-g].set(v[..., 0:1, :1, :])
+    out = out.at[..., 1:2, :g, g:-g].set(-v[..., 1:2, :1, :])
+    out = out.at[..., 0:1, -g:, g:-g].set(v[..., 0:1, -1:, :])
+    out = out.at[..., 1:2, -g:, g:-g].set(-v[..., 1:2, -1:, :])
+    # x strips read the y-padded columns so corners compose both flips
+    out = out.at[..., 0:1, :, :g].set(-out[..., 0:1, :, g:g + 1])
+    out = out.at[..., 1:2, :, :g].set(out[..., 1:2, :, g:g + 1])
+    out = out.at[..., 0:1, :, -g:].set(-out[..., 0:1, :, -g - 1:-g])
+    out = out.at[..., 1:2, :, -g:].set(out[..., 1:2, :, -g - 1:-g])
     return out
 
 

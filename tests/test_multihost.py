@@ -54,20 +54,19 @@ def test_two_process_amr_determinism(tmp_path):
         outs.append(out)
     digests = []
     iohashes = []
+    buckets = []
     for out in outs:
         lines = [ln for ln in out.splitlines() if ln.startswith("DIGEST")]
         assert len(lines) == 4, out       # 3 cycles + post-restore
         digests.append(lines)
         iohashes.append(
             [ln for ln in out.splitlines() if ln.startswith("IOHASH")])
+        buckets.append([ln for ln in out.splitlines()
+                        if ln.startswith("BUCKET")])
+        assert buckets[-1], out
         assert "DONE" in out
-        bucket = [ln for ln in out.splitlines()
-                  if ln.startswith("BUCKET")]
-        assert bucket, out
     # the hard case's bucket line must also agree across processes
-    assert ([ln for ln in outs[0].splitlines() if ln.startswith("BUCKET")]
-            == [ln for ln in outs[1].splitlines()
-                if ln.startswith("BUCKET")])
+    assert buckets[0] == buckets[1], buckets
     assert digests[0] == digests[1], (
         "processes diverged:\n" + "\n".join(
             f"{a}   vs   {b}" for a, b in zip(*digests)))
