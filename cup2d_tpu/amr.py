@@ -1263,6 +1263,13 @@ class AMRSim(ShapeHostMixin):
         # obstacle-free path, or one step runs at the stale dt — a
         # silent CFL violation (ADVICE r3 medium)
         self._ordered_state()
+        if self._last_iters_dev is not None:
+            # a pending obstacle-free iters scalar must be drained on
+            # entry to the shaped path (shapes appended mid-run): left
+            # pending, a later _float_pull would overwrite the fresher
+            # megastep-set _last_iters with this stale count and
+            # perturb the two-level trigger (ADVICE r4)
+            self._float_pull(jnp.zeros((), f.dtype))
         if dt is None:
             # prefer the dt the PREVIOUS megastep computed on device —
             # a fresh compute_dt() is a full host<->device round trip

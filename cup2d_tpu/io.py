@@ -252,6 +252,23 @@ def save_checkpoint(dirpath: str, sim) -> None:
                 sim._next_umax is not None
                 and getattr(sim, "_next_umax_version", -1) == fver),
         }
+    if hasattr(sim, "_coarse_on"):
+        # the production two-level trigger state must survive too, for
+        # the same same-branch contract: a restore that re-arms the
+        # trigger would run its first production solve on plain
+        # block-Jacobi (up to hundreds of iterations at 1e4 blocks)
+        # with a DIFFERENT preconditioner than the uninterrupted run
+        # (ADVICE r4 medium). The coarse maps themselves rebuild
+        # lazily (_use_coarse). A pending device iters scalar is
+        # drained first so the persisted count is the latest one.
+        if getattr(sim, "_last_iters_dev", None) is not None:
+            import jax
+            sim._last_iters = int(jax.device_get(sim._last_iters_dev))
+            sim._last_iters_dev = None
+        meta["poisson_trigger"] = {
+            "coarse_on": bool(sim._coarse_on),
+            "last_iters": int(sim._last_iters),
+        }
     with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
     # swap order matters for crash safety: park the old checkpoint aside,
@@ -281,6 +298,14 @@ def load_checkpoint(dirpath: str, sim) -> None:
         old = dirpath.rstrip("/") + ".old"
         if os.path.exists(os.path.join(old, "meta.json")):
             dirpath = old
+    with open(os.path.join(dirpath, "meta.json")) as f:
+        meta = json.load(f)
+    # counters BEFORE the field restore: the _refresh() below branches
+    # on step_count (a production-stage restore with the counter still
+    # at 0 would eagerly build the ~50 MB two-level coarse maps the
+    # lazy-trigger design defers — code-review r5)
+    sim.time = float(meta["time"])
+    sim.step_count = int(meta["step_count"])
     with np.load(os.path.join(dirpath, "fields.npz")) as data:
         if "__forest_keys" in data:
             f = sim.forest
@@ -321,10 +346,6 @@ def load_checkpoint(dirpath: str, sim) -> None:
                 k: jnp.asarray(data[k], dtype=sim.grid.dtype)
                 for k in sim.state._fields
             })
-    with open(os.path.join(dirpath, "meta.json")) as f:
-        meta = json.load(f)
-    sim.time = float(meta["time"])
-    sim.step_count = int(meta["step_count"])
     # restore the cached next-dt state (or clear it for checkpoints
     # predating dt_cache): the restart must take the SAME dt branch as
     # the uninterrupted run (see save_checkpoint)
@@ -343,6 +364,14 @@ def load_checkpoint(dirpath: str, sim) -> None:
             sim._next_umax = float(dtc["next_umax"])
             sim._next_umax_version = (
                 fver if dtc["next_umax_current"] else -1)
+    # restored AFTER the _refresh() above (which re-arms the trigger
+    # from scratch): the restart's first production solve must take the
+    # same preconditioner branch as the uninterrupted run (ADVICE r4)
+    trig = meta.get("poisson_trigger")
+    if trig and hasattr(sim, "_coarse_on"):
+        sim._coarse_on = bool(trig["coarse_on"])
+        sim._last_iters = int(trig["last_iters"])
+        sim._last_iters_dev = None
     shapes_path = os.path.join(dirpath, "shapes.pkl")
     if hasattr(sim, "shapes") and os.path.exists(shapes_path):
         with open(shapes_path, "rb") as f:

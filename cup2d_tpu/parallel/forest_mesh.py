@@ -34,9 +34,11 @@ from ..config import SimConfig
 
 
 def _exchange_mode() -> str:
-    """ONE selection point for the surface-exchange mode so the halo
-    gather and the flux-correction deposit exchange can never be built
-    in different modes for the same sim (code-review r4)."""
+    """Surface-exchange mode from the environment. Read ONCE per sim
+    (ShardedAMRSim.__init__) and stored on the instance: the halo
+    gather and the flux-correction deposit exchange are finalized in
+    separate calls within one _refresh, and an env mutation between
+    them must not build the two in different modes (ADVICE r4)."""
     import os
     return os.environ.get("CUP2D_SHARD_EXCHANGE", "ppermute")
 
@@ -53,6 +55,7 @@ class ShardedAMRSim(AMRSim):
     def __init__(self, cfg: SimConfig, mesh: Mesh,
                  shapes: Optional[Sequence] = None):
         self.mesh = mesh
+        self._exchange = _exchange_mode()
         super().__init__(cfg, shapes=shapes)
 
     def _shard_blocks(self, x):
@@ -96,7 +99,7 @@ class ShardedAMRSim(AMRSim):
         padded = {k: pad_tables(raw[k], n_pad)
                   for k in ("vec1t", "sca1t") if k in raw}
         out = dict(jax.device_put(padded, repl))
-        mode = _exchange_mode()
+        mode = self._exchange
         for k, t in raw.items():
             if k not in padded:
                 out[k] = shard_tables(t, n_pad, self.mesh, mode=mode)
@@ -111,7 +114,7 @@ class ShardedAMRSim(AMRSim):
         return shard_flux_corr(
             raw, n_pad, self.mesh, self.cfg.bs,
             dtype=np.dtype(self.forest.dtype),
-            mode=_exchange_mode())
+            mode=self._exchange)
 
     def _window_raster(self, inp, N):
         """Window rasterization with a shard-local scatter: every device
