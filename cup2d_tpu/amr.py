@@ -41,11 +41,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import SimConfig
-from .flux import apply_flux_corr, build_flux_corr, build_poisson_tables, \
-    diffusive_deposits, divergence_deposits, gradient_deposits
+from .flux import apply_flux_corr, build_flux_corr, \
+    build_poisson_structured, build_poisson_tables, diffusive_deposits, \
+    divergence_deposits, gradient_deposits, poisson_apply_structured
 from .forest import Forest
 from .halo import _TopoIndex, _bucket, assemble_labs, \
-    assemble_labs_ordered, build_tables, pad_tables
+    assemble_labs_ordered, build_face_copy, build_tables, \
+    make_fast_tables, pad_tables
 from . import native
 from .ops.collision import merged_overlap_integrals, \
     pairwise_collision_update
@@ -63,7 +65,7 @@ from .ops.obstacle import (
 from .ops.stencil import advect_diffuse_rhs, divergence, dt_from_umax, \
     laplacian5, pressure_gradient_update, vorticity
 from .poisson import apply_block_precond_blocks, bicgstab, \
-    block_precond_matrix, coarse_neumann_solve
+    block_precond_matrix, coarse_neumann_solve_dct
 from .profiling import NULL_TIMERS
 from .shapes_host import ShapeHostMixin
 
@@ -83,6 +85,28 @@ class ObstacleForestFields(NamedTuple):
     com: jnp.ndarray      # [S, 2] chi-corrected centers of mass
     mass: jnp.ndarray     # [S]
     inertia: jnp.ndarray  # [S]
+
+
+def _up2_bilinear(a: jnp.ndarray) -> jnp.ndarray:
+    """Cell-centered 2x bilinear upsample of a [H, W] image with edge
+    clamp: fine centers sit at quarter offsets, so the separable
+    weights are (3/4, 1/4). Pure slice/stack arithmetic — the ladder
+    step of the structured two-level transfers (no per-cell indices)."""
+    def up1(v):
+        vm = jnp.concatenate([v[:1], v[:-1]], axis=0)
+        vp = jnp.concatenate([v[1:], v[-1:]], axis=0)
+        even = 0.75 * v + 0.25 * vm
+        odd = 0.75 * v + 0.25 * vp
+        return jnp.stack([even, odd], axis=1).reshape(
+            2 * v.shape[0], *v.shape[1:])
+    return up1(up1(a).T).T
+
+
+def _down2_mean(a: jnp.ndarray) -> jnp.ndarray:
+    """2x2 mean coarsening of a [H, W] image (full-weighting adjoint
+    of nearest prolongation; each fine cell carries weight 1/4)."""
+    rows = a[0::2, :] + a[1::2, :]
+    return 0.25 * (rows[:, 0::2] + rows[:, 1::2])
 
 
 def _raster_neg(cfg, dtype):
@@ -271,8 +295,6 @@ class AMRSim(ShapeHostMixin):
                                       topo=topo),
                 "sca1t": build_tables(f, self._order, 1, True, 1,
                                       topo=topo),
-                # makeFlux variable-resolution Poisson rows (flux.py)
-                "pois": build_poisson_tables(f, self._order, topo=topo),
             }
             if self.shapes:
                 # chi tagging (g=4 scalar) + forces (g=4 vector)
@@ -284,7 +306,13 @@ class AMRSim(ShapeHostMixin):
         # numpy on purpose; per-leaf jnp.asarray would synchronize per
         # array — ~14 s/regrid through the TPU tunnel, measured)
         with tm.phase("tables/put"):
-            self._tables = self._finalize_tables(raw, n_pad)
+            fc = build_face_copy(f, self._order, n_pad, topo)
+            self._tables = self._finalize_tables(raw, n_pad, fc)
+            # makeFlux variable-resolution Poisson operator (flux.py):
+            # structured per-face form on a single device; the sharded
+            # subclass overrides with the lab-table + ppermute-exchange
+            # form (_build_pois)
+            self._tables["pois"] = self._build_pois(topo, n_pad)
         with tm.phase("tables/corr"):
             self._corr = self._finalize_corr(topo, n_pad)
         # two-level preconditioner maps: every cell's coarse cell on
@@ -332,8 +360,27 @@ class AMRSim(ShapeHostMixin):
         self._tables_version = f.version
 
     def _build_coarse_maps(self, n_pad: int, n_real: int):
-        """Host build of the exact-mode two-level transfer maps (see
-        _refresh_impl)."""
+        """Host build of the two-level transfer structure (see
+        _refresh_impl).
+
+        Round-5 re-design: the round-3/4 form was a generic per-cell
+        map ([cells, 4] bilinear indices + weights applied as one
+        scatter-add deposit and one gather interpolation). On TPU that
+        lowering is the adaptive path's single worst cost: the r5 op
+        trace of the 1e4-block probe showed ~36 ms PER 4.2M-row
+        scatter-add and ~30 ms per gather — ~630 ms of every 1163 ms
+        step inside the Krylov loop. The replacement is structured:
+        blocks are tile-aligned at their own level by construction, so
+        each level's blocks paint a uniform level-l image via ONE
+        block-row gather (embedding-style, 256 B rows), images walk to
+        the coarse level by 2x2 mean / bilinear 2x ladder steps (pure
+        reshape/slice arithmetic at full lane utilization), and the
+        per-level tile extraction on the way back is again one
+        block-row gather. No per-cell indices exist anywhere.
+
+        The pytree is a dict keyed by active level, so the jit
+        executable is keyed on the LEVEL SET (changes rarely, and only
+        at regrids) instead of per-cell map contents."""
         f = self.forest
         c = self._coarse_level = max(0, min(3, f.cfg.level_max - 1))
         bs_ = f.bs
@@ -341,54 +388,69 @@ class AMRSim(ShapeHostMixin):
         ncy = f.cfg.bpdy * bs_ << c
         self._coarse_shape = (ncy, ncx)
         self._coarse_h2 = float(f.cfg.h_at(c)) ** 2
-        lvo = f.level[self._order].astype(np.int64)
-        # BILINEAR transfer (4 coarse cells + weights per fine cell):
-        # piecewise-constant injection makes A(e) spike at every coarse
-        # cell border (the Laplacian of a step), which destroys rather
-        # than deflates the residual — measured corr(A e, r) = 0.33 on
-        # the canonical mixed forest vs 1.0 on matched levels.
-        H = float(f.cfg.h_at(c))
-        hcell = (f.cfg.h0 / (1 << lvo).astype(np.float64))[:, None, None]
-        ar_ = np.arange(bs_, dtype=np.float64)
-        px = (f.bi[self._order].astype(np.float64)[:, None, None] * bs_
-              + ar_[None, None, :] + 0.5) * hcell     # [n, 1, bs]
-        py = (f.bj[self._order].astype(np.float64)[:, None, None] * bs_
-              + ar_[None, :, None] + 0.5) * hcell     # [n, bs, 1]
-        px = np.broadcast_to(px, (n_real, bs_, bs_))
-        py = np.broadcast_to(py, (n_real, bs_, bs_))
-        fx = px / H - 0.5
-        fy = py / H - 0.5
-        ix0 = np.clip(np.floor(fx).astype(np.int64), 0, ncx - 1)
-        iy0 = np.clip(np.floor(fy).astype(np.int64), 0, ncy - 1)
-        ix1 = np.minimum(ix0 + 1, ncx - 1)
-        iy1 = np.minimum(iy0 + 1, ncy - 1)
-        tx = np.clip(fx - ix0, 0.0, 1.0)
-        ty = np.clip(fy - iy0, 0.0, 1.0)
-        pidx = np.stack([iy0 * ncx + ix0, iy0 * ncx + ix1,
-                         iy1 * ncx + ix0, iy1 * ncx + ix1], axis=-1)
-        pw = np.stack([(1 - tx) * (1 - ty), tx * (1 - ty),
-                       (1 - tx) * ty, tx * ty], axis=-1)
-        # residual deposits carry the cell's area fraction of a coarse
-        # cell (capped: cells coarser than c deposit as one full cell)
-        wq = np.minimum(4.0 ** (c - lvo), 1.0)[:, None, None, None]
-        pidx_p = np.zeros((n_pad, bs_, bs_, 4), np.int32)
-        pw_p = np.zeros((n_pad, bs_, bs_, 4), np.float64)
-        wd_p = np.zeros((n_pad, bs_, bs_, 4), np.float64)
-        pidx_p[:n_real] = pidx
-        pw_p[:n_real] = pw
-        wd_p[:n_real] = pw * wq
         fdt = jnp.dtype(f.dtype).name
-        self._coarse_cw = jax.device_put((
-            pidx_p.reshape(-1, 4),
-            np.asarray(pw_p.reshape(-1, 4), fdt),
-            np.asarray(wd_p.reshape(-1, 4), fdt)))
+        lvo = f.level[self._order].astype(np.int64)
+        bio = f.bi[self._order].astype(np.int64)
+        bjo = f.bj[self._order].astype(np.int64)
+        per_level = {}
+        for l in sorted(int(v) for v in np.unique(lvo)):
+            ntx = f.cfg.bpdx << l
+            nty = f.cfg.bpdy << l
+            sel = lvo == l
+            tix = bjo[sel] * ntx + bio[sel]
+            # tiles owned by no level-l block gather the first pad row
+            # (index n_real points into the pad range: n_pad > n_real)
+            # and are zeroed by ownm — pad-row data is stale, not NaN
+            own = np.full(nty * ntx, n_real, np.int32)
+            own[tix] = np.nonzero(sel)[0].astype(np.int32)
+            ownm = np.zeros(nty * ntx, fdt)
+            ownm[tix] = 1.0
+            tid = np.zeros(n_pad, np.int32)
+            tid[:n_real][sel] = tix.astype(np.int32)
+            selp = np.zeros(n_pad, fdt)
+            selp[:n_real][sel] = 1.0
+            per_level[l] = (own.reshape(nty, ntx),
+                            ownm.reshape(nty, ntx), tid, selp)
+        from .poisson import dct_neumann_operators
+        self._coarse_cw = jax.device_put({
+            "lev": per_level,
+            "dct": dct_neumann_operators(ncy, ncx, dtype=fdt),
+        })
 
+
+    # the hot-loop table sets that take the same-level face-copy fast
+    # path (halo.make_fast_tables); vec1t/sca1t are regrid-only and
+    # stay plain. Non-tensorial g=1 sets never fill lab corners, so
+    # their paint is face-only.
+    _FAST_SETS = {"vec3": True, "vec1": False, "sca1": False,
+                  "sca4t": True, "vec4t": True}
 
     # table placement hooks (ShardedAMRSim splits the hot-loop sets
     # into per-device rows + a surface-exchange plan)
-    def _finalize_tables(self, raw: dict, n_pad: int) -> dict:
-        return jax.device_put(
-            {k: pad_tables(t, n_pad) for k, t in raw.items()})
+    def _finalize_tables(self, raw: dict, n_pad: int, fc=None) -> dict:
+        out = {}
+        for k, t in raw.items():
+            if fc is not None and k in self._FAST_SETS:
+                out[k] = make_fast_tables(t, fc[0], fc[1], n_pad,
+                                          corners=self._FAST_SETS[k])
+            else:
+                out[k] = pad_tables(t, n_pad)
+        return jax.device_put(out)
+
+    def _build_pois(self, topo, n_pad: int):
+        """Poisson operator build hook: the structured per-face form
+        (build_poisson_structured) on a single device — its 2 block-row
+        gathers per face replace the lab scatter whose TPU lowering
+        serialized inside the Krylov loop (r5 trace). The sharded
+        subclass overrides with the lab-table form whose assembly rides
+        the ppermute surface-exchange plan. CUP2D_POIS=tables forces
+        the table form for A/B measurements."""
+        import os
+        if os.environ.get("CUP2D_POIS") == "tables":
+            t = build_poisson_tables(self.forest, self._order, topo=topo)
+            return jax.device_put(pad_tables(t, n_pad))
+        return jax.device_put(build_poisson_structured(
+            self.forest, self._order, n_pad, topo=topo))
 
     def _finalize_corr(self, topo, n_pad: int):
         return build_flux_corr(self.forest, self._order, n_pad=n_pad,
@@ -533,9 +595,14 @@ class AMRSim(ShapeHostMixin):
         b = apply_flux_corr(
             b, divergence_deposits(vlab, ulab, chi, fac[:, 0, 0]), corr)
 
-        def A(x):
-            lab = assemble_labs_ordered(x[:, None], tpois)
-            return laplacian5(lab, 1)[:, 0]
+        if hasattr(tpois, "nba"):
+            # structured per-face operator (flux.poisson_apply_structured)
+            def A(x):
+                return poisson_apply_structured(x, tpois)
+        else:
+            def A(x):
+                lab = assemble_labs_ordered(x[:, None], tpois)
+                return laplacian5(lab, 1)[:, 0]
 
         # initial-guess subtraction via A itself (the reference uses the
         # lab Laplacian + flux correction, pressure_rhs1; using A keeps
@@ -549,29 +616,74 @@ class AMRSim(ShapeHostMixin):
             # two-level preconditioner (VERDICT r2 #6): block-Jacobi
             # leaves the global pressure modes to the Krylov iteration
             # (hundreds of iterations on a cold RHS); a coarse
-            # uniform-grid correction (FFT-exact Neumann solve,
-            # poisson.coarse_neumann_solve) deflates them
-            # multiplicatively. Used for the cold startup solves and,
-            # since round 4, for PRODUCTION solves behind the driver's
-            # iters>15 trigger (step_once): on strongly compressed
-            # forests the warm deltap guess needs 2-5 block-Jacobi
-            # iterations and the extra A-apply per application would
-            # cost more than it saves — but at >= 1e4 near-uniform
-            # blocks the same solve runs ~200 iterations (r4 scale
-            # trace), the uniform path's block-Jacobi scaling law.
-            pidx, pw, wdep = tcoarse
+            # uniform-grid correction (exact Neumann solve) deflates
+            # them multiplicatively. Used for the cold startup solves
+            # and, since round 4, for PRODUCTION solves behind the
+            # driver's iters>15 trigger (step_once). Round-5 re-design
+            # of the transfers: per-level images painted by block-row
+            # gathers + 2x mean/bilinear ladder steps, and a DCT-matmul
+            # coarse solve — the r4 per-cell scatter/gather maps and
+            # the FFT's operand staging were ~630 of 1163 ms/step at
+            # 1e4 blocks (r5 trace; see _build_coarse_maps).
+            lev = tcoarse["lev"]
+            dctops = tcoarse["dct"]
             ncy, ncx = self._coarse_shape
+            c = self._coarse_level
+            bs = cfg.bs
+            lmin_p = min(lev)
+            lmax_p = max(lev)
             cih2 = jnp.where(hsq > 0,
                              1.0 / jnp.where(hsq > 0, hsq, 1.0), 0.0)
 
+            def _deposit(rp):
+                rc = jnp.zeros((ncy, ncx), rp.dtype)
+                for l in sorted(lev):
+                    own, ownm, tid, selp = lev[l]
+                    nty, ntx = own.shape
+                    img = rp[own.reshape(-1)] \
+                        * ownm.reshape(-1)[:, None, None]
+                    img = img.reshape(nty, ntx, bs, bs) \
+                             .transpose(0, 2, 1, 3) \
+                             .reshape(nty * bs, ntx * bs)
+                    if l > c:
+                        # mean ladder: each fine cell deposits its
+                        # area fraction 4^(c-l) (the r4 wq weight)
+                        for _ in range(l - c):
+                            img = _down2_mean(img)
+                    else:
+                        # coarser than c: spread the cell's unit
+                        # deposit uniformly over its coarse footprint
+                        for _ in range(c - l):
+                            img = jnp.repeat(
+                                jnp.repeat(img, 2, 0), 2, 1) * 0.25
+                    rc = rc + img
+                return rc
+
+            def _interp(ec, like):
+                imgs = {c: ec}
+                a = ec
+                for l in range(c + 1, lmax_p + 1):
+                    a = _up2_bilinear(a)
+                    imgs[l] = a
+                a = ec
+                for l in range(c - 1, lmin_p - 1, -1):
+                    a = _down2_mean(a)
+                    imgs[l] = a
+                e = jnp.zeros_like(like)
+                for l in sorted(lev):
+                    own, ownm, tid, selp = lev[l]
+                    nty, ntx = own.shape
+                    tiles = imgs[l].reshape(nty, bs, ntx, bs) \
+                                   .transpose(0, 2, 1, 3) \
+                                   .reshape(nty * ntx, bs, bs)
+                    e = e + tiles[tid] * selp[:, None, None]
+                return e
+
             def M(r):
-                rp = (r * cih2).reshape(-1)
-                rc = jnp.zeros((ncy * ncx,), r.dtype).at[
-                    pidx.reshape(-1)].add((rp[:, None] * wdep).reshape(-1))
-                ec = coarse_neumann_solve(
-                    rc.reshape(ncy, ncx), self._coarse_h2)
-                e = jnp.sum(ec.reshape(-1)[pidx] * pw, axis=-1)
-                e = e.reshape(r.shape)
+                rc = _deposit(r * cih2)
+                ec = coarse_neumann_solve_dct(
+                    rc, dctops, self._coarse_h2)
+                e = _interp(ec, r)
                 return e + apply_block_precond_blocks(
                     r - A(e), self.p_inv)
 

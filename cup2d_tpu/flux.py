@@ -219,6 +219,235 @@ def build_poisson_tables(forest: Forest, order: np.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# 1b. The same Poisson operator in structured (gather-free-rows) form
+#
+# Every ghost of the makeFlux closure is FACE-LOCAL: it combines (a) the
+# block's own edge/next-to-edge strips, (b) ONE face neighbor's edge
+# strip (same-level or coarse), or (c) TWO finer neighbors' edge strips
+# — and all tangential D1/D2 arithmetic is a fixed linear map on those
+# 8-vectors. So instead of per-ghost-cell gather rows (whose scatter
+# lowering serializes on TPU — the r5 1e4-block trace put the in-loop
+# lab assemblies among the top costs), the operator needs only 2
+# block-row gathers per face (embedding-style, one block = one 256 B
+# row) plus per-face [BS, BS] matmuls built ONCE from the same _D1/_D2
+# tables as the lab builder. Case selection (wall / same-level / coarse
+# / fine) is a host-built one-hot mask per face.
+#
+# The lab-table path stays: the sharded hot loop assembles through the
+# ppermute surface-exchange plan (shard_halo), and the equivalence test
+# (tests/test_flux.py) pins the two paths against each other so the
+# constants can never diverge.
+# ---------------------------------------------------------------------------
+
+
+class PoissonOp(NamedTuple):
+    """Structured makeFlux operator tables (single-device hot path).
+
+    Per face f in the _FACES order, arrays over the padded ordered
+    block axis: ``nba[f]``/``nbb[f]`` gather source rows (fine-case
+    halves; equal otherwise), ``m_same/m_coarse/m_fine/m_wall[f]`` the
+    case one-hots, ``par[f]`` the coarse-interpolation parity. The
+    static [BS, BS] tangential matrices ride along so the jitted apply
+    is self-contained."""
+
+    nba: jnp.ndarray       # [4, n_pad] int32 ordered positions
+    nbb: jnp.ndarray       # [4, n_pad]
+    m_same: jnp.ndarray    # [4, n_pad] dtype
+    m_coarse: jnp.ndarray  # [4, n_pad]
+    m_fine: jnp.ndarray    # [4, n_pad]
+    m_wall: jnp.ndarray    # [4, n_pad]
+    par: jnp.ndarray       # [4, n_pad] dtype (0.0 / 1.0)
+    wc0: jnp.ndarray       # [BS, BS] coarse-ghost strip map, parity 0
+    wc1: jnp.ndarray       # [BS, BS] parity 1
+    mcl: jnp.ndarray       # [2, BS, BS] fine close-col maps per half
+    mfr: jnp.ndarray       # [2, BS, BS] fine far-col maps per half
+    d2own: jnp.ndarray     # [BS, BS] own-edge D2 map (coarse side)
+
+
+jax.tree_util.register_pytree_node(
+    PoissonOp,
+    lambda t: (tuple(t), ()),
+    lambda aux, ch: PoissonOp(*ch),
+)
+
+
+def _structured_matrices(bs: int):
+    """The static tangential maps of the makeFlux closure, from the
+    SAME _D1/_D2 tables as _PoissonLabBuilder (shared constants by
+    construction). Row t of each matrix holds the weights over the
+    gathered 8-strip for ghost cell t."""
+    wc = np.zeros((2, bs, bs))
+    for par in (0, 1):
+        for t in range(bs):
+            tc = t // 2 + par * (bs // 2)
+            st = -1.0 if t % 2 == 0 else 1.0
+            wc[par, t, tc] += 8.0 / 15.0
+            for d, w in _D1[_dkind(tc, bs)]:
+                wc[par, t, tc + d] += st * (8.0 / 15.0) * w
+            for d, w in _D2[_dkind(tc, bs)]:
+                wc[par, t, tc + d] += (8.0 / 15.0) * w
+    mcl = np.zeros((2, bs, bs))
+    mfr = np.zeros((2, bs, bs))
+    for half in (0, 1):
+        for t in range(half * (bs // 2), (half + 1) * (bs // 2)):
+            tf0 = 2 * (t % (bs // 2))
+            for tf in (tf0, tf0 + 1):
+                mcl[half, t, tf] += 1.0 / 3.0
+                mfr[half, t, tf] += 1.0 / 5.0
+    d2own = np.zeros((bs, bs))
+    for t in range(bs):
+        for d, w in _D2[_dkind(t, bs)]:
+            d2own[t, t + d] += w
+    return wc[0], wc[1], mcl, mfr, d2own
+
+
+def build_poisson_structured(forest: Forest, order: np.ndarray,
+                             n_pad: int, topo=None) -> PoissonOp:
+    """Host build of the structured operator (vectorized over the dense
+    topology index; a few [n_pad] arrays per face — no per-cell rows)."""
+    bs = forest.bs
+    n_real = len(order)
+    assert n_pad > n_real
+    if topo is None:
+        topo = _TopoIndex(forest, order)
+    lv = forest.level[order].astype(np.int64)
+    biv = forest.bi[order].astype(np.int64)
+    bjv = forest.bj[order].astype(np.int64)
+    ordpos_of = np.full(forest.capacity, n_real, np.int64)
+    ordpos_of[order] = np.arange(n_real)
+    fdt = np.dtype(jnp.dtype(forest.dtype).name)
+
+    nba = np.full((4, n_pad), n_real, np.int32)
+    nbb = np.full((4, n_pad), n_real, np.int32)
+    masks = np.zeros((4, 4, n_pad), fdt)   # [case, face, n_pad]
+    par = np.zeros((4, n_pad), fdt)
+    for face, (cx, cy) in enumerate(_FACES):
+        rel = topo.rel_at(lv, biv + cx, bjv + cy)
+        wall = rel == -3          # off-domain: zero-flux face
+        same = rel == 0
+        coarse = rel == -2
+        fine = rel == -1
+        masks[3, face, :n_real][wall] = 1.0
+        masks[0, face, :n_real][same] = 1.0
+        masks[1, face, :n_real][coarse] = 1.0
+        masks[2, face, :n_real][fine] = 1.0
+        s_same = topo.slot_at(lv, biv + cx, bjv + cy)
+        s_coarse = topo.slot_at(lv - 1, (biv + cx) >> 1, (bjv + cy) >> 1)
+        if cx != 0:
+            a = 1 if cx < 0 else 0
+            fa_i = 2 * (biv + cx) + a
+            fa_j = 2 * bjv
+            fb_j = 2 * bjv + 1
+            s_fa = topo.slot_at(lv + 1, fa_i, fa_j)
+            s_fb = topo.slot_at(lv + 1, fa_i, fb_j)
+            par[face, :n_real] = (bjv & 1).astype(fdt)
+        else:
+            b_ = 1 if cy < 0 else 0
+            fa_j = 2 * (bjv + cy) + b_
+            s_fa = topo.slot_at(lv + 1, 2 * biv, fa_j)
+            s_fb = topo.slot_at(lv + 1, 2 * biv + 1, fa_j)
+            par[face, :n_real] = (biv & 1).astype(fdt)
+        a_slot = np.where(same, s_same,
+                          np.where(coarse, s_coarse,
+                                   np.where(fine, s_fa, -1)))
+        b_slot = np.where(fine, s_fb, a_slot)
+        nba[face, :n_real] = np.where(
+            a_slot >= 0, ordpos_of[np.maximum(a_slot, 0)], n_real)
+        nbb[face, :n_real] = np.where(
+            b_slot >= 0, ordpos_of[np.maximum(b_slot, 0)], n_real)
+
+    wc0, wc1, mcl, mfr, d2own = _structured_matrices(bs)
+    # numpy leaves on purpose: the caller device_puts the whole op in
+    # ONE async transfer (per-leaf jnp.asarray costs one synchronous
+    # tunnel round trip each — the same ~14 s/regrid lesson as
+    # halo.pad_tables)
+    return PoissonOp(
+        nba=nba, nbb=nbb,
+        m_same=masks[0], m_coarse=masks[1],
+        m_fine=masks[2], m_wall=masks[3],
+        par=par,
+        wc0=wc0.astype(fdt), wc1=wc1.astype(fdt),
+        mcl=mcl.astype(fdt), mfr=mfr.astype(fdt),
+        d2own=d2own.astype(fdt),
+    )
+
+
+def poisson_apply_structured(x: jnp.ndarray, op: PoissonOp) -> jnp.ndarray:
+    """A(x) for [n_pad, BS, BS] ordered x: within-block 5-point part
+    plus the four per-face ghost strips (case-selected linear maps of
+    gathered neighbor strips). Equivalent (same weights, slightly
+    different f32 summation order) to
+    `laplacian5(assemble_labs_ordered(x, tpois), 1)[:, 0]`.
+
+    Layout discipline (the round-5 lever): all strip/stencil math runs
+    BLOCKS-LAST — strips are [BS, N] (full 128-lane rows instead of the
+    16x-padded [N, BS]), the shifted-neighbor fields are built by
+    concatenation along the major cell axes of a [BS, BS, N] transpose,
+    and the tangential maps apply as [BS, BS] @ [BS, N] MXU matmuls at
+    HIGHEST precision (the default bf16 pass truncates the D1/D2
+    weights enough to destroy the two-level correction — measured
+    8 -> 121 Krylov iterations). Only the neighbor-block gathers stay
+    block-major (one block = one 256 B row, the fast gather pattern),
+    paying one explicit [N,8,8] -> [8,8,N] relayout each."""
+    bs = x.shape[1]
+    xt = x.transpose(1, 2, 0)                     # [y, x, N]
+
+    def mm(a, b):
+        return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+
+    c23, c15, c1615 = 2.0 / 3.0, 1.0 / 5.0, 16.0 / 15.0
+
+    def ghost(face):
+        """[BS, N] ghost strip (tangential index first)."""
+        cx, cy = _FACES[face]
+        At = x[op.nba[face]].transpose(1, 2, 0)   # [y, x, N]
+        Bt = x[op.nbb[face]].transpose(1, 2, 0)
+        if cx != 0:
+            own_e = xt[:, 0, :] if cx < 0 else xt[:, bs - 1, :]
+            own_e1 = xt[:, 1, :] if cx < 0 else xt[:, bs - 2, :]
+            n_edge = bs - 1 if cx < 0 else 0
+            far = bs - 2 if cx < 0 else 1
+            sA = At[:, n_edge, :]
+            far_a = At[:, far, :]
+            close_b, far_b = Bt[:, n_edge, :], Bt[:, far, :]
+        else:
+            own_e = xt[0, :, :] if cy < 0 else xt[bs - 1, :, :]
+            own_e1 = xt[1, :, :] if cy < 0 else xt[bs - 2, :, :]
+            n_edge = bs - 1 if cy < 0 else 0
+            far = bs - 2 if cy < 0 else 1
+            sA = At[n_edge, :, :]
+            far_a = At[far, :, :]
+            close_b, far_b = Bt[n_edge, :, :], Bt[far, :, :]
+        # same-level copy
+        g_same = sA
+        # fine side of a coarse neighbor: strip map per parity
+        gc0 = mm(op.wc0, sA)
+        gc1 = mm(op.wc1, sA)
+        pf = op.par[face][None, :]
+        g_coarse = (c23 * own_e - c15 * own_e1
+                    + (1.0 - pf) * gc0 + pf * gc1)
+        # coarse side of finer neighbors: subface sums + own D2
+        # sA doubles as the fine close-column (same edge slice)
+        g_fine = ((1.0 - c1615) * own_e
+                  + mm(op.mcl[0], sA) + mm(op.mfr[0], far_a)
+                  + mm(op.mcl[1], close_b) + mm(op.mfr[1], far_b)
+                  - c1615 * mm(op.d2own, own_e))
+        return (op.m_same[face][None, :] * g_same
+                + op.m_coarse[face][None, :] * g_coarse
+                + op.m_fine[face][None, :] * g_fine
+                + op.m_wall[face][None, :] * own_e)
+
+    gw, ge, gs, gn = ghost(0), ghost(1), ghost(2), ghost(3)
+    xw = jnp.concatenate([gw[:, None, :], xt[:, :-1, :]], axis=1)
+    xe = jnp.concatenate([xt[:, 1:, :], ge[:, None, :]], axis=1)
+    xs_ = jnp.concatenate([gs[None, :, :], xt[:-1, :, :]], axis=0)
+    xn = jnp.concatenate([xt[1:, :, :], gn[None, :, :]], axis=0)
+    lapt = xw + xe + xs_ + xn - 4.0 * xt
+    return lapt.transpose(2, 0, 1)
+
+
+
+# ---------------------------------------------------------------------------
 # 2. Flux-correction index tables + per-kernel face deposits
 # ---------------------------------------------------------------------------
 

@@ -85,15 +85,18 @@ class ShardedAMRSim(AMRSim):
         self._yc = jax.device_put(self._yc, shard)
         self._order_j = jax.device_put(self._order_j, shard)
 
-    def _finalize_tables(self, raw, n_pad):
+    def _finalize_tables(self, raw, n_pad, fc=None):
         """Hot-loop table sets become per-device rows + a surface
         exchange plan (shard_halo) — the reference's per-rank
         synchronizer plans (main.cpp:909-1391). The regrid prolongation
         sets (vec1t/sca1t) read slot-layout fields outside the sharded
-        hot loop and stay replicated."""
+        hot loop and stay replicated. The face-copy fast path (``fc``)
+        is single-device-only: its block gathers would cross shard
+        boundaries as GSPMD whole-field collectives, so the sharded
+        assembly keeps the full tables + ppermute exchange."""
         from .shard_halo import shard_tables
         if n_pad % self.mesh.devices.size:
-            return super()._finalize_tables(raw, n_pad)
+            return super()._finalize_tables(raw, n_pad, fc=None)
         from ..halo import pad_tables
         repl = NamedSharding(self.mesh, P())
         padded = {k: pad_tables(raw[k], n_pad)
@@ -104,6 +107,19 @@ class ShardedAMRSim(AMRSim):
             if k not in padded:
                 out[k] = shard_tables(t, n_pad, self.mesh, mode=mode)
         return out
+
+    def _build_pois(self, topo, n_pad):
+        """Sharded Poisson operator: the lab-table form, assembled
+        through the per-device ppermute exchange plan (the structured
+        per-face gathers would cross shard boundaries as GSPMD
+        whole-field collectives)."""
+        from ..flux import build_poisson_tables
+        from .shard_halo import shard_tables
+        t = build_poisson_tables(self.forest, self._order, topo=topo)
+        if n_pad % self.mesh.devices.size:
+            from ..halo import pad_tables
+            return jax.device_put(pad_tables(t, n_pad))
+        return shard_tables(t, n_pad, self.mesh, mode=self._exchange)
 
     def _finalize_corr(self, topo, n_pad):
         from ..flux import build_flux_corr

@@ -178,6 +178,57 @@ class MultigridPreconditioner:
         return self._smooth(e, r, lvl, self.nu2)
 
 
+def dct_neumann_operators(ncy: int, ncx: int, dtype=np.float32):
+    """Host-precomputed operators for the matmul form of
+    ``coarse_neumann_solve``: DCT-II basis matrices (forward + exact
+    inverse via the orthogonality weights), and the reciprocal
+    eigenvalue grid with the constant nullspace mode zeroed.
+
+    Why matmuls and not jnp.fft: the mirror-extension rfft2 lowers to
+    an XLA FFT custom call whose operand staging dominated the entire
+    coarse solve on TPU (r5 trace of the 1e4-block probe: ~3.7 ms per
+    128 KB copy-start around each FFT, ~19 of them per step — more
+    device time than the Krylov arithmetic). The same diagonalization
+    as the even extension, cos(pi k (i+0.5)/n) with eigenvalues
+    2cos(pi k/n) - 2 per axis, is four tiny matmuls on the MXU."""
+    def fwd(n):
+        k = np.arange(n)[:, None]
+        i = np.arange(n)[None, :]
+        return np.cos(np.pi * k * (i + 0.5) / n)
+
+    cyf = fwd(ncy)
+    cxf = fwd(ncx)
+    # exact inverse from DCT-II row orthogonality: row norms are n (k=0)
+    # and n/2 (k>0)
+    wy = np.full(ncy, 2.0 / ncy); wy[0] = 1.0 / ncy
+    wx = np.full(ncx, 2.0 / ncx); wx[0] = 1.0 / ncx
+    cyi = (cyf * wy[:, None]).T
+    cxi = (cxf * wx[:, None]).T
+    ky = 2.0 * np.cos(np.pi * np.arange(ncy) / ncy) - 2.0
+    kx = 2.0 * np.cos(np.pi * np.arange(ncx) / ncx) - 2.0
+    lam = ky[:, None] + kx[None, :]
+    ilam = np.where(lam < -1e-12, 1.0 / np.where(lam < -1e-12, lam, 1.0),
+                    0.0)
+    return (cyf.astype(dtype), cyi.astype(dtype),
+            cxf.astype(dtype), cxi.astype(dtype), ilam.astype(dtype))
+
+
+def coarse_neumann_solve_dct(rc: jnp.ndarray, ops, h2) -> jnp.ndarray:
+    """Exact undivided-Neumann solve as 4 matmuls (see
+    dct_neumann_operators); identical diagonalization to
+    ``coarse_neumann_solve``, returning e * h2 with the constant mode
+    projected out. HIGHEST matmul precision: the default bf16 MXU pass
+    would corrupt the cosine bases exactly like it corrupted the
+    structured-operator strip maps (flux.poisson_apply_structured)."""
+    cyf, cyi, cxf, cxi, ilam = ops
+
+    def mm(a, b):
+        return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+
+    F = mm(mm(cyf, rc), cxf.T)
+    return h2 * mm(mm(cyi, F * ilam), cxi.T)
+
+
 def coarse_neumann_solve(rc: jnp.ndarray, h2) -> jnp.ndarray:
     """Exact solve of the UNDIVIDED 5-point Neumann Laplacian on a small
     uniform grid, L e = rc, returning e * h2 (the divided-operator
