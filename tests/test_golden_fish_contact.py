@@ -1,0 +1,47 @@
+"""Fish-fish contact golden regression (VERDICT r4 #5).
+
+The disk golden pins the impulse math on rigid bodies; this pins the
+canonical event — a deforming two-fish head-on encounter through the
+chi-overlap impulse — including per-shape surface forces, against
+numbers recorded by `python -m validation.golden_fish_contact --write`
+(CPU f64). Regenerate consciously after legitimate numerics changes."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from validation.golden_fish_contact import GOLDEN_PATH, N_STEPS, \
+    run_trajectory
+
+
+@pytest.mark.skipif(not os.path.exists(GOLDEN_PATH),
+                    reason="golden_fish_contact.json not generated")
+def test_golden_fish_contact_trajectory():
+    with open(GOLDEN_PATH) as f:
+        want = json.load(f)
+    got = run_trajectory()
+    assert len(got["steps"]) == len(want["steps"]) == N_STEPS
+    assert got["impulse_step"] == want["impulse_step"]
+    for i, (g, w) in enumerate(zip(got["steps"], want["steps"])):
+        np.testing.assert_allclose(g["time"], w["time"], rtol=1e-12)
+        for k, (bg, bw) in enumerate(zip(g["bodies"], w["bodies"])):
+            np.testing.assert_allclose(
+                bg["com"], bw["com"], rtol=0, atol=1e-7,
+                err_msg=f"step {i} body {k} com")
+            for q in ("u", "v", "omega"):
+                np.testing.assert_allclose(
+                    bg[q], bw[q], rtol=1e-6, atol=1e-9,
+                    err_msg=f"step {i} body {k} {q}")
+            for q in ("fx", "fy", "torque"):
+                np.testing.assert_allclose(
+                    bg[q], bw[q], rtol=1e-5, atol=1e-10,
+                    err_msg=f"step {i} body {k} {q}")
+    # the pinned window must actually contain the impulse: the closing
+    # velocity reverses sign across impulse_step (same style as
+    # test_golden_collision.py) — body 0 closes (u < 0, it sits on the
+    # right) then recedes (u > 0)
+    s = want["impulse_step"]
+    assert want["steps"][s - 1]["bodies"][0]["u"] < -0.05
+    assert want["steps"][s]["bodies"][0]["u"] > 0.05

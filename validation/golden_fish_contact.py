@@ -1,0 +1,147 @@
+"""Deforming-body (fish-fish) contact golden (VERDICT r4 #5).
+
+The two-disk golden (validation/golden_collision.py) pins the impulse
+math through a rigid contact, but the canonical case's actual event is
+a FISH-fish head-on encounter — deforming bodies, where the
+chi-overlap integrals and skin normals are most stressed
+(reference main.cpp:6705-6943 detection/response on the swimmers of
+run.sh). This pins that event: two fish driven nose-to-nose by seeded
+rigid-motion flow blobs on a coarse AMR forest (CPU f64, levelMax 4 —
+the smallest resolution whose finest cells resolve the fish width),
+recording per-step rigid states AND per-shape surface forces across
+the impulse to tests/golden_fish_contact.json.
+
+The generator asserts the window contains a genuine approach ->
+impulse -> recede sequence (closing du ~ -0.24 flips to ~ +0.24 in one
+step, the e=1 signature), so the golden can never silently pin a miss.
+
+    JAX_PLATFORMS=cpu python -m validation.golden_fish_contact --write
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+
+GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "golden_fish_contact.json")
+
+N_STEPS = 12
+DT = 0.008
+
+
+def _force_cpu_x64():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+
+def build_sim():
+    _force_cpu_x64()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cup2d_tpu.amr import AMRSim
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.models.fish import FishShape
+
+    cfg = SimConfig(bpdx=2, bpdy=1, level_max=4, level_start=3,
+                    extent=1.0, dtype="float64", nu=2e-4, lam=1e6,
+                    cfl=0.4, rtol=1e9, ctol=-1.0,
+                    max_poisson_iterations=60, poisson_tol=1e-6,
+                    poisson_tol_rel=1e-4)
+    L = 0.3
+    fa = FishShape(L, 0.66, 0.25, 180.0, cfg.min_h)
+    fb = FishShape(L, 0.34, 0.25, 0.0, cfg.min_h)
+    sim = AMRSim(cfg, shapes=[fa, fb])
+    sim.compute_forces_every = 1
+    sim.force_log = io.StringIO()
+    sim.initialize()
+
+    # rigid-motion flow blobs drive the pair together (the momentum
+    # solve derives body velocity from the flow — same seeding pattern
+    # as the disk golden)
+    sim.sync_fields()
+    f = sim.forest
+    order = f.order()
+    bs = cfg.bs
+    h = f.h_per_block(order)
+    ar = np.arange(bs) + 0.5
+    xc = (f.bi[order].astype(np.float64) * bs * h)[:, None, None] \
+        + ar[None, None, :] * h[:, None, None]
+    yc = (f.bj[order].astype(np.float64) * bs * h)[:, None, None] \
+        + ar[None, :, None] * h[:, None, None]
+    vel = np.array(f.fields["vel"])
+    u0 = 0.6
+    blob = np.zeros((len(order), bs, bs))
+    for (cx, cy, uu) in ((0.66, 0.25, -u0), (0.34, 0.25, u0)):
+        rr2 = (xc - cx) ** 2 + (yc - cy) ** 2
+        blob += uu * np.exp(-rr2 / (2.0 * (0.5 * L) ** 2))
+    vel[order, 0] = blob
+    vel[order, 1] = 0.0
+    f.fields["vel"] = jnp.asarray(vel)
+    return sim
+
+
+def run_trajectory():
+    sim = build_sim()
+    rec = {"steps": []}
+    for _ in range(N_STEPS):
+        mark = sim.force_log.tell()
+        sim.step_once(dt=DT)
+        sim.force_log.seek(mark)
+        rows = [r.split(",") for r in
+                sim.force_log.read().strip().splitlines() if r]
+        sim.force_log.seek(0, io.SEEK_END)
+        forces = {}
+        for r in rows:
+            # header: time,shape,perimeter,circulation,forcex,forcey,...
+            forces[int(r[1])] = {
+                "fx": float(r[4]), "fy": float(r[5]),
+                "torque": float(r[10]),
+            }
+        rec["steps"].append({
+            "time": float(sim.time),
+            "bodies": [
+                {"com": [float(s.com[0]), float(s.com[1])],
+                 "u": float(s.u), "v": float(s.v),
+                 "omega": float(s.omega),
+                 **forces.get(k, {})}
+                for k, s in enumerate(sim.shapes)
+            ],
+        })
+    # the window must contain the impulse: the pair closes hard, then
+    # the closing velocity REVERSES in one step (e=1 pair impulse)
+    du = [st["bodies"][0]["u"] - st["bodies"][1]["u"]
+          for st in rec["steps"]]         # negative while closing
+    imin = du.index(min(du))
+    assert min(du) < -0.15, f"fish never closed hard: {du}"
+    assert max(du[imin:]) > 0.05, \
+        f"no impulse reversal after closest approach: {du}"
+    rec["impulse_step"] = next(
+        i for i in range(imin, N_STEPS) if du[i] > 0.05)
+    # forces must be live through the event (the surface kernel sees
+    # deforming skins in proximity)
+    assert any(abs(st["bodies"][0].get("fx", 0.0)) > 0.0
+               for st in rec["steps"]), "forces all zero"
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write", action="store_true")
+    args = ap.parse_args()
+    rec = run_trajectory()
+    print(json.dumps(rec, indent=1))
+    if args.write:
+        with open(GOLDEN_PATH, "w") as f:
+            json.dump(rec, f, indent=1, sort_keys=True)
+        print(f"wrote {GOLDEN_PATH}")
+
+
+if __name__ == "__main__":
+    main()
