@@ -31,7 +31,9 @@ class _CompileCounter(logging.Handler):
         self.events: list[str] = []
 
     def emit(self, record):
-        m = re.search(r"Compiling ([\w.<>\[\]_-]+)", record.getMessage())
+        msg = record.getMessage()
+        m = re.search(r"Finished XLA compilation of (?:jit\()?"
+                      r"([\w.<>\[\]_-]+)", msg)
         if m:
             self.events.append(m.group(1))
 
