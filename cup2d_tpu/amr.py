@@ -195,6 +195,8 @@ class AMRSim(ShapeHostMixin):
             static_argnames=("exact_poisson", "with_forces"))
         self._next_dt = None
         self._next_dt_version = -1
+        self._dt_jit = None
+        self._umax_jit = None
         self._next_umax = None   # survives regrids (see step_once)
         self._next_umax_version = -1
         # production two-level trigger (VERDICT r3 #9): when the last
@@ -340,11 +342,18 @@ class AMRSim(ShapeHostMixin):
 
         hp = np.concatenate([h, np.ones(n_pad - n_real)])
         hsqp = np.concatenate([h * h, np.zeros(n_pad - n_real)])
-        self._h = jnp.asarray(hp, f.dtype)[:, None, None, None]
-        self._h3 = self._h[:, 0]
-        self._hflat = jnp.asarray(hp, f.dtype)
-        self._hsq_flat = jnp.asarray(hsqp, f.dtype)[:, None, None]
-        self._maskv = jnp.asarray(self._mask, f.dtype)[:, None, None, None]
+        # shape on the HOST (numpy reshapes), transfer once: the eager
+        # [:, None] slicing of device arrays compiled a one-op
+        # executable per distinct shape — 38 of the 62 warm-init
+        # executables were such one-op jits (r5 init_compiles probe),
+        # each paying the tunnel's per-executable transport
+        fdt = np.dtype(jnp.dtype(f.dtype).name)
+        self._h = jnp.asarray(hp.reshape(-1, 1, 1, 1).astype(fdt))
+        self._h3 = jnp.asarray(hp.reshape(-1, 1, 1).astype(fdt))
+        self._hflat = jnp.asarray(hp.astype(fdt))
+        self._hsq_flat = jnp.asarray(hsqp.reshape(-1, 1, 1).astype(fdt))
+        self._maskv = jnp.asarray(
+            self._mask.reshape(-1, 1, 1, 1).astype(fdt))
         self._order_j = jnp.asarray(order_p)
         # cell centers per active block (device, for obstacle kernels)
         bs = f.bs
@@ -1258,8 +1267,22 @@ class AMRSim(ShapeHostMixin):
         """ops.stencil.dt_from_umax in the forest dtype — the device
         path (_megastep_impl's cached next-dt) and the host fallback
         (compute_dt) must agree bit-for-bit or a restart forks the
-        trajectory the checkpoint machinery promises to preserve."""
-        return dt_from_umax(umax, hmin, self.cfg.nu, self.cfg.cfl)
+        trajectory the checkpoint machinery promises to preserve.
+
+        Jitted when called from host driver code (traced callers hit
+        the isinstance-of-Tracer branch and inline it): the eager form
+        compiled 6+ one-op executables (abs/max/divide/minimum/...)
+        whose per-executable tunnel transport is a real slice of warm
+        init (r5 init_compiles probe: 38 of 62 init executables were
+        such one-op jits)."""
+        if isinstance(umax, jax.core.Tracer) or \
+                isinstance(hmin, jax.core.Tracer):
+            return dt_from_umax(umax, hmin, self.cfg.nu, self.cfg.cfl)
+        if self._dt_jit is None:
+            self._dt_jit = jax.jit(
+                lambda u, h: dt_from_umax(u, h, self.cfg.nu,
+                                          self.cfg.cfl))
+        return self._dt_jit(jnp.asarray(umax, self.forest.dtype), hmin)
 
     def _hmin(self):
         """Finest active spacing as a device scalar — the ONE
@@ -1276,8 +1299,10 @@ class AMRSim(ShapeHostMixin):
         # fallback after external field writes — a plain float() here
         # would discard the pending poisson-iters scalar and disarm
         # the two-level trigger exactly on such drivers (code-review r4)
-        umax = jnp.max(jnp.abs(
-            self._ordered_state()["vel"]) * self._maskv)
+        if self._umax_jit is None:
+            self._umax_jit = jax.jit(
+                lambda v, m: jnp.max(jnp.abs(v) * m))
+        umax = self._umax_jit(self._ordered_state()["vel"], self._maskv)
         return self._float_pull(self._dt_from_umax(umax, self._hmin()))
 
     def _use_coarse(self, exact: bool):
