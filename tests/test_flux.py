@@ -401,3 +401,27 @@ def test_fast_face_copy_assembly_matches_tables():
         np.testing.assert_array_equal(
             got[:n], want[:n],
             err_msg=f"g={g} tensorial={tensorial} dim={dim}")
+
+
+def test_pois_build_selects_structured_with_env_fallback(monkeypatch):
+    """Single-device AMRSim must actually WIRE the structured operator
+    into its hot-loop tables (a silent fallback to the lab-table form
+    would erase the round-5 speedup without failing anything), and
+    CUP2D_POIS=tables must restore the table form for A/B runs."""
+    from cup2d_tpu.amr import AMRSim
+    from cup2d_tpu.flux import PoissonOp
+    from cup2d_tpu.halo import HaloTables
+
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=2, level_start=1,
+                    extent=1.0, dtype="float64")
+    # an ambient CUP2D_POIS from the documented A/B workflow must not
+    # fail the default-wiring assertion
+    monkeypatch.delenv("CUP2D_POIS", raising=False)
+    sim = AMRSim(cfg, shapes=[])
+    sim._refresh()
+    assert isinstance(sim._tables["pois"], PoissonOp)
+
+    monkeypatch.setenv("CUP2D_POIS", "tables")
+    sim2 = AMRSim(cfg, shapes=[])
+    sim2._refresh()
+    assert isinstance(sim2._tables["pois"], HaloTables)
