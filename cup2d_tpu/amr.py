@@ -688,13 +688,42 @@ class AMRSim(ShapeHostMixin):
                     e = e + tiles[tid] * selp[:, None, None]
                 return e
 
-            def M(r):
-                rc = _deposit(r * cih2)
-                ec = coarse_neumann_solve_dct(
-                    rc, dctops, self._coarse_h2)
-                e = _interp(ec, r)
-                return e + apply_block_precond_blocks(
-                    r - A(e), self.p_inv)
+            # form selection: PRODUCTION solves use the ADDITIVE
+            # two-level (coarse correction + block-Jacobi on the same
+            # residual — no embedded A-apply). The r4 A/B called it a
+            # wash (7/896 vs 8/947 ms) because transfers dominated;
+            # with the r5 structured operator the saved 2 A-applies
+            # per iteration are real: 155.8 -> 128.6 ms/step at 1e4
+            # blocks, iterations unchanged at 8 (BASELINE.md r5).
+            # STARTUP (exact) solves keep the multiplicative form —
+            # their 2-26-iteration convergence pedigree (r4) was
+            # established with it, and 10 solves/run don't pay the
+            # hot-loop price. CUP2D_TWOLEVEL={additive,mult} forces
+            # one form for A/B probes.
+            import os as _os
+            form = _os.environ.get(
+                "CUP2D_TWOLEVEL",
+                "mult" if exact_poisson else "additive")
+            if form not in ("additive", "mult"):
+                # a typo'd A/B gate must not silently fall back and
+                # measure the same form on both arms
+                raise ValueError(
+                    f"CUP2D_TWOLEVEL={form!r}: expected additive|mult")
+            if form == "additive":
+                def M(r):
+                    rc = _deposit(r * cih2)
+                    ec = coarse_neumann_solve_dct(
+                        rc, dctops, self._coarse_h2)
+                    return _interp(ec, r) + apply_block_precond_blocks(
+                        r, self.p_inv)
+            else:
+                def M(r):
+                    rc = _deposit(r * cih2)
+                    ec = coarse_neumann_solve_dct(
+                        rc, dctops, self._coarse_h2)
+                    e = _interp(ec, r)
+                    return e + apply_block_precond_blocks(
+                        r - A(e), self.p_inv)
 
         # the cold startup solves start from x0 = M(b): one two-level
         # application removes the global pressure modes from r0 before

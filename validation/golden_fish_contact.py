@@ -35,6 +35,10 @@ DT = 0.008
 
 def _force_cpu_x64():
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # this case's tight tolerances engage the production two-level
+    # trigger, so an ambient CUP2D_TWOLEVEL from the A/B workflow
+    # would silently record/replay the wrong preconditioner form
+    os.environ.pop("CUP2D_TWOLEVEL", None)
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
