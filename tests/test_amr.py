@@ -518,3 +518,20 @@ def test_production_two_level_trigger():
     sim._refresh()
     assert not sim._coarse_on
     assert sim._last_iters == 0 and sim._last_iters_dev is None
+
+
+def test_twolevel_env_gate_rejects_typos(monkeypatch):
+    """CUP2D_TWOLEVEL typos must raise, not silently fall back — an
+    A/B probe that measures the same form on both arms reports the
+    additive speedup as gone (code-review r5)."""
+    import pytest as _pytest
+
+    from cup2d_tpu.amr import AMRSim
+    from cup2d_tpu.config import SimConfig
+
+    monkeypatch.setenv("CUP2D_TWOLEVEL", "add")
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=2, level_start=1,
+                    extent=1.0, dtype="float64")
+    sim = AMRSim(cfg, shapes=[])
+    with _pytest.raises(ValueError, match="CUP2D_TWOLEVEL"):
+        sim.step_once(dt=1e-3)
