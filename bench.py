@@ -253,7 +253,7 @@ def _profiled_step(step, state, dt, cells: int) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
-def run_adaptive(n_warm_steps: int = 60, chain: int = 20):
+def run_adaptive(n_warm_steps: int = 40, chain: int = 15):
     """The CANONICAL adaptive case as a first-class bench number
     (VERDICT r4 #2): the reference's own run.sh two-fish configuration
     (levelMax 8, finest cap 4096x2048 — /root/reference/run.sh:1-22),
@@ -385,8 +385,8 @@ def main():
     if os.environ.get("BENCH_ADAPTIVE", "1") != "0":
         try:
             adaptive = run_adaptive(
-                n_warm_steps=int(os.environ.get("BENCH_ADAPT_WARM", "60")),
-                chain=int(os.environ.get("BENCH_ADAPT_CHAIN", "20")))
+                n_warm_steps=int(os.environ.get("BENCH_ADAPT_WARM", "40")),
+                chain=int(os.environ.get("BENCH_ADAPT_CHAIN", "15")))
         except Exception as e:           # noqa: BLE001 - bench must print
             adaptive = {"error": f"{type(e).__name__}: {e}"}
 
