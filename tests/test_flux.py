@@ -425,3 +425,35 @@ def test_pois_build_selects_structured_with_env_fallback(monkeypatch):
     sim2 = AMRSim(cfg, shapes=[])
     sim2._refresh()
     assert isinstance(sim2._tables["pois"], HaloTables)
+
+
+def test_fast_paint_collapses_rows_to_interfaces():
+    """The face-copy filter must remove ALL interior same-level rows on
+    a uniform forest — leaving only wall/BC rows — or the paint
+    silently stops paying for itself (the scatter it replaces is the
+    serialized TPU lowering the round-5 speedup removed)."""
+    from cup2d_tpu.halo import build_face_copy, build_tables, \
+        filter_face_rows
+
+    cfg = SimConfig(bpdx=2, bpdy=2, level_max=2, level_start=1,
+                    extent=1.0, dtype="float64")
+    f = Forest(cfg)           # uniform 4x4 level-1 grid
+    order = f.order()
+    n = len(order)
+    nb, mask = build_face_copy(f, order, n + 3)
+    t = build_tables(f, order, 3, True, 2)
+    ft = filter_face_rows(t, mask, corners=True)
+    # interior blocks (no wall side) contribute ZERO remaining rows;
+    # the survivors must all belong to wall-touching blocks
+    L2 = t.L * t.L
+    import numpy as _np
+    lv = f.level[order]
+    bi = f.bi[order]
+    bj = f.bj[order]
+    nbx = cfg.bpdx << 1
+    nby = cfg.bpdy << 1
+    wallb = (bi == 0) | (bi == nbx - 1) | (bj == 0) | (bj == nby - 1)
+    surv_blocks = _np.asarray(ft.dest_s) // L2
+    assert len(ft.dest_s) < len(t.dest_s)          # filter engaged
+    assert wallb[surv_blocks].all(), \
+        "interior same-level rows survived the paint filter"
