@@ -256,7 +256,7 @@ def _profiled_step(step, state, dt, cells: int) -> dict:
 def run_adaptive(n_warm_steps: int = 40, chain: int = 15):
     """The CANONICAL adaptive case as a first-class bench number
     (VERDICT r4 #2): the reference's own run.sh two-fish configuration
-    (levelMax 8, finest cap 4096x2048 — /root/reference/run.sh:1-22),
+    (levelMax 8, finest cap 2048x1024 — /root/reference/run.sh:1-22),
     warmed through real driver steps + regrids, then timed as chained
     frozen-input megasteps with a profiler trace (device time, not
     tunnel wall). Reports active-cell throughput AND the

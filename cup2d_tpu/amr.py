@@ -146,6 +146,23 @@ class AMRSim(ShapeHostMixin):
 
     def __init__(self, cfg: SimConfig, shapes: Optional[Sequence] = None):
         self.cfg = cfg
+        # A/B env gates latched ONCE per sim, matching the
+        # ShardedAMRSim._exchange pattern (ADVICE r5): a mid-run env
+        # mutation must not silently flip the operator/preconditioner
+        # form at the next retrace or regrid
+        import os
+        self._pois_mode = os.environ.get("CUP2D_POIS", "structured")
+        self._twolevel_form = os.environ.get("CUP2D_TWOLEVEL")
+        # a typo'd A/B gate must not silently fall back and measure
+        # the same form on both arms
+        if self._pois_mode not in ("structured", "tables"):
+            raise ValueError(
+                f"CUP2D_POIS={self._pois_mode!r}: "
+                "expected structured|tables")
+        if self._twolevel_form not in (None, "additive", "mult"):
+            raise ValueError(
+                f"CUP2D_TWOLEVEL={self._twolevel_form!r}: "
+                "expected additive|mult")
         if shapes is None:
             from .sim import make_shapes
             shapes = make_shapes(cfg)
@@ -451,11 +468,10 @@ class AMRSim(ShapeHostMixin):
         (build_poisson_structured) on a single device — its 2 block-row
         gathers per face replace the lab scatter whose TPU lowering
         serialized inside the Krylov loop (r5 trace). The sharded
-        subclass overrides with the lab-table form whose assembly rides
-        the ppermute surface-exchange plan. CUP2D_POIS=tables forces
-        the table form for A/B measurements."""
-        import os
-        if os.environ.get("CUP2D_POIS") == "tables":
+        subclass overrides with per-device rows behind the ppermute
+        surface-exchange plan. CUP2D_POIS=tables (latched in __init__)
+        forces the table form for A/B measurements."""
+        if self._pois_mode == "tables":
             t = build_poisson_tables(self.forest, self._order, topo=topo)
             return jax.device_put(pad_tables(t, n_pad))
         return jax.device_put(build_poisson_structured(
@@ -698,17 +714,11 @@ class AMRSim(ShapeHostMixin):
             # STARTUP (exact) solves keep the multiplicative form —
             # their 2-26-iteration convergence pedigree (r4) was
             # established with it, and 10 solves/run don't pay the
-            # hot-loop price. CUP2D_TWOLEVEL={additive,mult} forces
-            # one form for A/B probes.
-            import os as _os
-            form = _os.environ.get(
-                "CUP2D_TWOLEVEL",
+            # hot-loop price. CUP2D_TWOLEVEL={additive,mult} (latched
+            # in __init__, validated there) forces one form for A/B
+            # probes.
+            form = self._twolevel_form or (
                 "mult" if exact_poisson else "additive")
-            if form not in ("additive", "mult"):
-                # a typo'd A/B gate must not silently fall back and
-                # measure the same form on both arms
-                raise ValueError(
-                    f"CUP2D_TWOLEVEL={form!r}: expected additive|mult")
             if form == "additive":
                 def M(r):
                     rc = _deposit(r * cih2)

@@ -233,10 +233,12 @@ def build_poisson_tables(forest: Forest, order: np.ndarray,
 # tables as the lab builder. Case selection (wall / same-level / coarse
 # / fine) is a host-built one-hot mask per face.
 #
-# The lab-table path stays: the sharded hot loop assembles through the
-# ppermute surface-exchange plan (shard_halo), and the equivalence test
-# (tests/test_flux.py) pins the two paths against each other so the
-# constants can never diverge.
+# The lab-table path stays as the A/B reference (CUP2D_POIS=tables) and
+# the equivalence test (tests/test_flux.py) pins the two forms against
+# each other so the constants can never diverge. On a device mesh the
+# same per-face gathers run per shard against [own ++ received surface]
+# rows (parallel.shard_halo.ShardPoissonOp) — the strip math below is
+# shared verbatim through _structured_lap.
 # ---------------------------------------------------------------------------
 
 
@@ -372,12 +374,35 @@ def build_poisson_structured(forest: Forest, order: np.ndarray,
     )
 
 
-def poisson_apply_structured(x: jnp.ndarray, op: PoissonOp) -> jnp.ndarray:
+def poisson_apply_structured(x: jnp.ndarray, op) -> jnp.ndarray:
     """A(x) for [n_pad, BS, BS] ordered x: within-block 5-point part
     plus the four per-face ghost strips (case-selected linear maps of
     gathered neighbor strips). Equivalent (same weights, slightly
     different f32 summation order) to
     `laplacian5(assemble_labs_ordered(x, tpois), 1)[:, 0]`.
+
+    Dispatches to the shard-local apply when given a per-device
+    operator (parallel.shard_halo.ShardPoissonOp — same strip math via
+    `_structured_lap`, gather sources remapped into [own ++ received
+    surface] space behind an explicit ppermute exchange)."""
+    if hasattr(op, "apply"):
+        return op.apply(x)
+    return _structured_lap(
+        x, x, op.nba, op.nbb, op.m_same, op.m_coarse, op.m_fine,
+        op.m_wall, op.par, (op.wc0, op.wc1, op.mcl, op.mfr, op.d2own))
+
+
+def _structured_lap(x_own: jnp.ndarray, x_src: jnp.ndarray,
+                    nba, nbb, m_same, m_coarse, m_fine, m_wall, par,
+                    mats) -> jnp.ndarray:
+    """The ONE strip-math body of the structured makeFlux operator.
+
+    ``x_own`` [N, BS, BS] holds the rows the laplacian is computed for;
+    ``x_src`` [M, BS, BS] is the gather space ``nba``/``nbb`` index —
+    x_own itself on a single device, [own blocks ++ received surface
+    blocks] on a shard. Every tangential map reduces over BS only
+    (elementwise in N), so the sharded per-device apply is bit-identical
+    to the single-device one per block row by construction.
 
     Layout discipline (the round-5 lever): all strip/stencil math runs
     BLOCKS-LAST — strips are [BS, N] (full 128-lane rows instead of the
@@ -389,8 +414,9 @@ def poisson_apply_structured(x: jnp.ndarray, op: PoissonOp) -> jnp.ndarray:
     8 -> 121 Krylov iterations). Only the neighbor-block gathers stay
     block-major (one block = one 256 B row, the fast gather pattern),
     paying one explicit [N,8,8] -> [8,8,N] relayout each."""
-    bs = x.shape[1]
-    xt = x.transpose(1, 2, 0)                     # [y, x, N]
+    wc0, wc1, mcl, mfr, d2own = mats
+    bs = x_own.shape[1]
+    xt = x_own.transpose(1, 2, 0)                 # [y, x, N]
 
     def mm(a, b):
         return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
@@ -400,8 +426,8 @@ def poisson_apply_structured(x: jnp.ndarray, op: PoissonOp) -> jnp.ndarray:
     def ghost(face):
         """[BS, N] ghost strip (tangential index first)."""
         cx, cy = _FACES[face]
-        At = x[op.nba[face]].transpose(1, 2, 0)   # [y, x, N]
-        Bt = x[op.nbb[face]].transpose(1, 2, 0)
+        At = x_src[nba[face]].transpose(1, 2, 0)  # [y, x, N]
+        Bt = x_src[nbb[face]].transpose(1, 2, 0)
         if cx != 0:
             own_e = xt[:, 0, :] if cx < 0 else xt[:, bs - 1, :]
             own_e1 = xt[:, 1, :] if cx < 0 else xt[:, bs - 2, :]
@@ -421,21 +447,21 @@ def poisson_apply_structured(x: jnp.ndarray, op: PoissonOp) -> jnp.ndarray:
         # same-level copy
         g_same = sA
         # fine side of a coarse neighbor: strip map per parity
-        gc0 = mm(op.wc0, sA)
-        gc1 = mm(op.wc1, sA)
-        pf = op.par[face][None, :]
+        gc0 = mm(wc0, sA)
+        gc1 = mm(wc1, sA)
+        pf = par[face][None, :]
         g_coarse = (c23 * own_e - c15 * own_e1
                     + (1.0 - pf) * gc0 + pf * gc1)
         # coarse side of finer neighbors: subface sums + own D2
         # sA doubles as the fine close-column (same edge slice)
         g_fine = ((1.0 - c1615) * own_e
-                  + mm(op.mcl[0], sA) + mm(op.mfr[0], far_a)
-                  + mm(op.mcl[1], close_b) + mm(op.mfr[1], far_b)
-                  - c1615 * mm(op.d2own, own_e))
-        return (op.m_same[face][None, :] * g_same
-                + op.m_coarse[face][None, :] * g_coarse
-                + op.m_fine[face][None, :] * g_fine
-                + op.m_wall[face][None, :] * own_e)
+                  + mm(mcl[0], sA) + mm(mfr[0], far_a)
+                  + mm(mcl[1], close_b) + mm(mfr[1], far_b)
+                  - c1615 * mm(d2own, own_e))
+        return (m_same[face][None, :] * g_same
+                + m_coarse[face][None, :] * g_coarse
+                + m_fine[face][None, :] * g_fine
+                + m_wall[face][None, :] * own_e)
 
     gw, ge, gs, gn = ghost(0), ghost(1), ghost(2), ghost(3)
     xw = jnp.concatenate([gw[:, None, :], xt[:, :-1, :]], axis=1)

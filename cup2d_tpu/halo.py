@@ -672,18 +672,26 @@ def make_fast_tables(t: HaloTables, nb: np.ndarray, mask: np.ndarray,
     return FastHalo(t=ft, nb=nb, mask=mask, corners=corners)
 
 
-def _fast_paint(x: jnp.ndarray, labs: jnp.ndarray, fh: FastHalo,
-                bs: int):
-    """Masked structured writes of every same-level strip (uncovered
-    blocks write zeros there; their rows remain in the tables and the
-    scatters below fill them)."""
-    g = fh.t.g
-    regions = _fc_regions(g, bs, fh.corners)
+def _paint_regions(x: jnp.ndarray, labs: jnp.ndarray, nb, mask,
+                   g: int, bs: int, corners: bool):
+    """The ONE paint body: masked structured writes of every
+    same-level strip (uncovered blocks write zeros there; their rows
+    remain in the tables and the scatters after the paint fill them).
+    Shared by the single-device FastHalo path and the shard-local
+    paint (parallel.shard_halo._assemble_sharded), so the two can
+    never drift from the bit-exactness the equality tests pin."""
+    regions = _fc_regions(g, bs, corners)
     for o, (sy, sx, ssy, ssx) in enumerate(regions):
-        src = x[fh.nb[o]][:, :, ssy, ssx] \
-            * fh.mask[o][:, None, None, None].astype(x.dtype)
+        src = x[nb[o]][:, :, ssy, ssx] \
+            * mask[o][:, None, None, None].astype(x.dtype)
         labs = labs.at[:, :, sy, sx].set(src)
     return labs
+
+
+def _fast_paint(x: jnp.ndarray, labs: jnp.ndarray, fh: FastHalo,
+                bs: int):
+    return _paint_regions(x, labs, fh.nb, fh.mask, fh.t.g, bs,
+                          fh.corners)
 
 
 def pad_tables(t: HaloTables, n_pad: int) -> HaloTables:

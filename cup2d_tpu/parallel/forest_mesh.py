@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..amr import AMRSim
 from ..config import SimConfig
+from .shard_halo import _shard_map
 
 
 def _exchange_mode() -> str:
@@ -90,10 +91,12 @@ class ShardedAMRSim(AMRSim):
         exchange plan (shard_halo) — the reference's per-rank
         synchronizer plans (main.cpp:909-1391). The regrid prolongation
         sets (vec1t/sca1t) read slot-layout fields outside the sharded
-        hot loop and stay replicated. The face-copy fast path (``fc``)
-        is single-device-only: its block gathers would cross shard
-        boundaries as GSPMD whole-field collectives, so the sharded
-        assembly keeps the full tables + ppermute exchange."""
+        hot loop and stay replicated. The same-level face-copy fast
+        path (``fc``) runs SHARD-LOCALLY: pairs living on one shard are
+        painted by structured block-row writes inside shard_map (no
+        collective at all), and only the faces that actually cross a
+        shard boundary keep gather rows riding the surface exchange —
+        the round-5 paint at round-4 communication volume."""
         from .shard_halo import shard_tables
         if n_pad % self.mesh.devices.size:
             return super()._finalize_tables(raw, n_pad, fc=None)
@@ -105,21 +108,32 @@ class ShardedAMRSim(AMRSim):
         mode = self._exchange
         for k, t in raw.items():
             if k not in padded:
-                out[k] = shard_tables(t, n_pad, self.mesh, mode=mode)
+                kw = {}
+                if fc is not None and k in self._FAST_SETS:
+                    kw = dict(fc=fc, corners=self._FAST_SETS[k])
+                out[k] = shard_tables(t, n_pad, self.mesh, mode=mode,
+                                      **kw)
         return out
 
     def _build_pois(self, topo, n_pad):
-        """Sharded Poisson operator: the lab-table form, assembled
-        through the per-device ppermute exchange plan (the structured
-        per-face gathers would cross shard boundaries as GSPMD
-        whole-field collectives)."""
-        from ..flux import build_poisson_tables
-        from .shard_halo import shard_tables
-        t = build_poisson_tables(self.forest, self._order, topo=topo)
+        """Sharded Poisson operator: the round-5 structured per-face
+        form (flux.build_poisson_structured), split into per-device
+        block rows whose neighbor gathers read [own ++ received
+        surface] behind the same ppermute exchange plan as the halo
+        sets (shard_halo.shard_poisson_op) — one closure signature on
+        one device and on eight. CUP2D_POIS=tables restores the
+        round-4 lab-table + exchange form for A/B measurements."""
+        from ..flux import build_poisson_structured, build_poisson_tables
+        from .shard_halo import shard_poisson_op, shard_tables
         if n_pad % self.mesh.devices.size:
-            from ..halo import pad_tables
-            return jax.device_put(pad_tables(t, n_pad))
-        return shard_tables(t, n_pad, self.mesh, mode=self._exchange)
+            return super()._build_pois(topo, n_pad)
+        if self._pois_mode == "tables":
+            t = build_poisson_tables(self.forest, self._order, topo=topo)
+            return shard_tables(t, n_pad, self.mesh, mode=self._exchange)
+        op = build_poisson_structured(self.forest, self._order, n_pad,
+                                      topo=topo)
+        return shard_poisson_op(op, n_pad, self.mesh,
+                                mode=self._exchange)
 
     def _finalize_corr(self, topo, n_pad):
         from ..flux import build_flux_corr
@@ -148,7 +162,7 @@ class ShardedAMRSim(AMRSim):
         dtype = self.forest.dtype
         B = N // D
 
-        @partial(jax.shard_map, mesh=self.mesh,
+        @partial(_shard_map, mesh=self.mesh,
                  in_specs=(P(),),
                  out_specs=(P("x"), P(None, "x"), P("x")))
         def win(inp_r):

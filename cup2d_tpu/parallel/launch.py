@@ -64,6 +64,19 @@ import jax
 from .mesh import make_mesh
 
 
+def _dist_initialized() -> bool:
+    """jax.distributed.is_initialized with a fallback for the image's
+    jax 0.4.x line (the accessor landed later): the runtime is up iff
+    the global distributed client exists. Same check, private spelling
+    — and it never touches the XLA backend, preserving this module's
+    no-probe contract."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    from jax._src import distributed as _dist
+    return _dist.global_state.client is not None
+
+
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None,
@@ -87,7 +100,7 @@ def init_distributed(coordinator_address: Optional[str] = None,
     unless that many processes actually joined. Returns this process's
     index.
     """
-    if jax.distributed.is_initialized():
+    if _dist_initialized():
         rank = jax.process_index()
     else:
         explicit = (coordinator_address is not None

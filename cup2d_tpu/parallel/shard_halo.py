@@ -17,17 +17,22 @@ This module restores that scaling law on the device mesh:
 * the surface buffer packs only blocks some OTHER shard references —
   the shard-boundary halo (SFC-contiguous shards keep it thin, the
   same locality argument as the reference's SFC rank ranges);
-* assembly runs under `shard_map`: pack own surface blocks, ONE
-  `lax.all_gather` of the packed buffer over the mesh axis, then purely
-  local gathers/scatters.
+* assembly runs under `shard_map`: pack own surface blocks, one
+  `lax.ppermute` per shard offset over the sparse pairs that actually
+  send (or one mesh-wide surface all-gather in audit mode), then purely
+  local gathers/scatters — including the shard-local FastHalo paint of
+  same-level strips whose neighbor lives on the same shard.
 
 The flux-correction fix-up (fine-face deposits added into coarse rows,
 main.cpp:1392-1849) gets the identical treatment with face-deposit rows
-as the exchanged payload.
+as the exchanged payload, and the structured per-face Poisson operator
+(flux.PoissonOp) rides the same plan with neighbor block rows as the
+payload (ShardPoissonOp).
 
 Per-device row counts and surface sizes are padded to power-of-two
 buckets so regrids reuse compiled executables (same rationale as
-halo.pad_tables).
+halo.pad_tables); surface buckets are per offset so pod-scale meshes
+don't pay the worst pair's bucket on every pair.
 """
 
 from __future__ import annotations
@@ -40,7 +45,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..halo import HaloTables, _bucket
+from ..halo import HaloTables, _bucket, _paint_regions, filter_face_rows
+
+try:                                   # stable API (jax >= 0.5)
+    from jax import shard_map as _shard_map
+except ImportError:                    # this image's 0.4.x line
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 
 class ShardTables(NamedTuple):
@@ -71,9 +81,22 @@ class ShardTables(NamedTuple):
     consume the received buffer afterwards. The reference overlaps the
     same way — inner blocks compute while halo messages fly
     (main.cpp:864-893 avail_next + computeA 3024-3061).
+
+    Shard-local FastHalo paint (round-5 fast path on the mesh): when
+    built with the face-copy structure, ``fc_nb``/``fc_mask`` carry,
+    per device and per neighbor offset, the OWN-shard same-level
+    neighbor of each own block; the assembly paints those strips with
+    structured block-row gathers + static-slice writes (halo._fast_paint
+    on the shard), and the covered rows are filtered out of the tables
+    host-side (halo.filter_face_rows). Only faces whose same-level
+    neighbor lives on ANOTHER shard keep their gather rows — they ride
+    the surface exchange like any remote row. The paint reads x_loc
+    only, so it is part of the exchange-independent work the scheduler
+    can hide the collective behind. ``n_regions`` is 0 (no paint), 4
+    (faces only) or 8 (tensorial sets paint corners too).
     """
 
-    pack: jnp.ndarray     # [D, n_off, S] int32 own blocks to export
+    pack: tuple           # per-offset [D, S_o] int32 own blocks to export
     src_l: jnp.ndarray    # [D, Gsl] int32 (local-only simple rows)
     sign_l: jnp.ndarray   # [D, Gsl, dim]
     dest_sl: jnp.ndarray  # [D, Gsl] int32
@@ -86,14 +109,18 @@ class ShardTables(NamedTuple):
     idx_r: jnp.ndarray    # [D, Ggr, K] int32
     w_r: jnp.ndarray      # [D, Ggr, K, dim]
     dest_r: jnp.ndarray   # [D, Ggr] int32
+    fc_nb: jnp.ndarray    # [D, n_regions, B] int32 own-shard positions
+    fc_mask: jnp.ndarray  # [D, n_regions, B] field-dtype 1.0/0.0
     mesh: Mesh
     B: int                # blocks per device
-    S: int                # surface bucket (mode-dependent semantics)
+    S: int                # WORST per-offset surface bucket (metadata)
     L: int
     g: int
     dim: int
     offsets: tuple        # static nonzero shard offsets (ppermute mode)
     mode: str             # "ppermute" | "allgather"
+    n_regions: int        # 0 = no paint, 4 = faces, 8 = faces+corners
+    perms: tuple          # per-offset static (src, dst) sending pairs
 
     def assemble(self, x: jnp.ndarray) -> jnp.ndarray:
         return _assemble_sharded(x, self)
@@ -103,8 +130,9 @@ jax.tree_util.register_pytree_node(
     ShardTables,
     lambda t: ((t.pack, t.src_l, t.sign_l, t.dest_sl, t.idx_l, t.w_l,
                 t.dest_l, t.src_r, t.sign_r, t.dest_sr, t.idx_r, t.w_r,
-                t.dest_r),
-               (t.mesh, t.B, t.S, t.L, t.g, t.dim, t.offsets, t.mode)),
+                t.dest_r, t.fc_nb, t.fc_mask),
+               (t.mesh, t.B, t.S, t.L, t.g, t.dim, t.offsets, t.mode,
+                t.n_regions, t.perms)),
     lambda aux, ch: ShardTables(*ch, *aux),
 )
 
@@ -112,11 +140,26 @@ jax.tree_util.register_pytree_node(
 def _build_exchange_plan(remote_by_d, D: int, B: int, n_pad: int,
                          mode: str):
     """Common surface-exchange plan from the per-consumer remote-block
-    sets: returns (offsets, S, pack[D, n_off, S], g2surf[D, n_pad])
-    where g2surf[d, gblk] is the position of remote block gblk in
-    consumer d's received-surface space (-1 if not received). Shared by
-    the halo gather and the flux-correction deposit exchange so the two
-    plans can never drift (code-review r4)."""
+    sets: returns (offsets, S, pack, perms, g2surf) where ``pack`` is a
+    TUPLE of per-offset [D, S_o] own-block index arrays (one element
+    [D, S] in allgather mode), ``perms`` the per-offset static
+    (src, dst) pair lists restricted to pairs that actually send, and
+    g2surf[d, gblk] the position of remote block gblk in consumer d's
+    received-surface space (-1 if not received). Shared by the halo
+    gather, the flux-correction deposit exchange and the structured
+    Poisson operator so the plans can never drift (code-review r4).
+
+    Buckets are PER OFFSET and the ppermute perm lists are sparse
+    (round 6, VERDICT r5 weak #5): the earlier plan shipped one shared
+    power-of-two bucket for every (device, offset) pair, so pod-scale
+    meshes — whose SFC shard adjacency has many rare offsets with 1-2
+    blocks each — paid bucket-width wire traffic on all of them
+    (measured 2.64 -> 4.05 MB/device over 8 -> 64 devices at 1e4
+    blocks; 36x padded/real on the 16x16 probe). Per-offset buckets +
+    real-pair perms keep padded bytes within a small factor of the
+    payload at any device count (tests/test_comm_volume.py bounds it).
+    ``S`` stays the WORST per-offset bucket — the shape metadata the
+    boundary-proportionality tests key on."""
     if mode == "allgather":
         # one shared surface set per owner, broadcast to every device
         surf_lists: list[list[int]] = [[] for _ in range(D)]
@@ -127,14 +170,13 @@ def _build_exchange_plan(remote_by_d, D: int, B: int, n_pad: int,
                     surf_pos[gblk] = len(surf_lists[gblk // B])
                     surf_lists[gblk // B].append(gblk)
         S = _bucket(max((len(x) for x in surf_lists), default=1), lo=4)
-        offsets: tuple = ()
-        pack = np.zeros((D, 1, S), np.int32)
+        pack0 = np.zeros((D, S), np.int32)
         for e, lst in enumerate(surf_lists):
-            pack[e, 0, :len(lst)] = np.asarray(lst, np.int64) - e * B
+            pack0[e, :len(lst)] = np.asarray(lst, np.int64) - e * B
         g2surf = np.full((D, n_pad), -1, np.int64)
         for gblk, p in surf_pos.items():
             g2surf[:, gblk] = (gblk // B) * S + p
-        return offsets, S, pack, g2surf
+        return (), S, (pack0,), (), g2surf
     # per (owner, offset) send lists; offset = consumer - owner
     send: dict = {}
     for d in range(D):
@@ -142,20 +184,60 @@ def _build_exchange_plan(remote_by_d, D: int, B: int, n_pad: int,
             e = gblk // B
             send.setdefault((e, d - e), []).append(gblk)
     offsets = tuple(sorted({o for (_, o) in send}))
-    n_off = max(len(offsets), 1)
-    S = _bucket(max((len(v) for v in send.values()), default=1), lo=4)
-    pack = np.zeros((D, n_off, S), np.int32)
+    S_per = [_bucket(max((len(v) for (e, o), v in send.items()
+                          if o == off), default=1), lo=4)
+             for off in offsets]
+    off_base = np.concatenate([[0], np.cumsum(S_per)]).astype(np.int64)
+    pack = tuple(np.zeros((D, s), np.int32) for s in S_per)
+    perms = tuple(
+        tuple(sorted(e for (e, o) in send if o == off))
+        for off in offsets)
     g2surf = np.full((D, n_pad), -1, np.int64)
     for (e, o), lst in send.items():
         oi = offsets.index(o)
-        pack[e, oi, :len(lst)] = np.asarray(lst, np.int64) - e * B
+        pack[oi][e, :len(lst)] = np.asarray(lst, np.int64) - e * B
         for p, gblk in enumerate(lst):
-            g2surf[e + o, gblk] = oi * S + p
-    return offsets, S, pack, g2surf
+            g2surf[e + o, gblk] = off_base[oi] + p
+    perms = tuple(tuple((e, e + offsets[oi]) for e in srcs)
+                  for oi, srcs in enumerate(perms))
+    return offsets, max(S_per, default=0), pack, perms, g2surf
+
+
+def _halo_remote_by_d(t: HaloTables, n_pad: int, D: int):
+    """Per-consumer-device remote-block demand of a halo table set —
+    the ONE derivation behind both the shipped exchange plan
+    (shard_tables) and the host-only padding audit
+    (exchange_padding_stats), so the CI padding guard can never audit
+    a different plan than the one in production. Also returns the
+    derived row/device arrays so shard_tables reuses them instead of
+    recomputing per regrid: (remote_by_d, zmask, dev_s, dev_g,
+    src_blk, idx_blk)."""
+    B = n_pad // D
+    bs = t.L - 2 * t.g
+    bs2 = bs * bs
+    LL = t.L * t.L
+    src = np.asarray(t.src_ord, np.int64)
+    idx = np.asarray(t.idx_ord, np.int64)
+    # zero-weight K-padding entries must not create surface demand
+    zmask = (np.asarray(t.w) == 0).all(axis=2)
+    dev_s = (np.asarray(t.dest_s, np.int64) // LL) // B
+    dev_g = (np.asarray(t.dest, np.int64) // LL) // B
+    src_blk = src // bs2
+    idx_blk = idx // bs2
+    remote_by_d = []
+    for d in range(D):
+        ref = np.concatenate([
+            src_blk[dev_s == d],
+            idx_blk[dev_g == d][~zmask[dev_g == d]],
+        ])
+        remote_by_d.append(
+            np.unique(ref[(ref < d * B) | (ref >= (d + 1) * B)]))
+    return remote_by_d, zmask, dev_s, dev_g, src_blk, idx_blk
 
 
 def shard_tables(t: HaloTables, n_pad: int, mesh: Mesh,
-                 mode: str = "ppermute") -> ShardTables:
+                 mode: str = "ppermute", fc=None,
+                 corners: bool = True) -> ShardTables:
     """Split (unpadded, numpy-leaf) tables into per-device rows with a
     surface-buffer exchange plan. ``n_pad`` must divide by the mesh
     size (amr buckets are powers of two >= 128).
@@ -163,7 +245,16 @@ def shard_tables(t: HaloTables, n_pad: int, mesh: Mesh,
     mode="ppermute" (default): per-offset neighbor sends; traffic per
     device scales with its OWN shard boundary. mode="allgather": the
     round-3 mesh-wide surface all-gather, kept for the comm-scaling
-    audit (validation/comm_audit.py measures both)."""
+    audit (validation/comm_audit.py measures both).
+
+    ``fc`` = (nb, mask) from halo.build_face_copy enables the
+    shard-local FastHalo paint: the global same-level face-copy mask is
+    restricted to pairs living on the SAME shard, the covered rows are
+    dropped from the tables (halo.filter_face_rows), and the per-device
+    neighbor positions ride along for the structured strip writes.
+    Cross-shard same-level faces keep their gather rows (they need the
+    surface exchange anyway). ``corners`` follows the table set's
+    tensoriality exactly as on the single-device path."""
     D = mesh.devices.size
     assert n_pad % D == 0, (n_pad, D)
     B = n_pad // D
@@ -171,6 +262,28 @@ def shard_tables(t: HaloTables, n_pad: int, mesh: Mesh,
     bs = L - 2 * g
     bs2 = bs * bs
     LL = L * L
+
+    n_regions = 0
+    if fc is not None:
+        nb_g, mask_g = np.asarray(fc[0]), np.asarray(fc[1])
+        n_regions = 8 if corners else 4
+        # paint only pairs whose blocks share a shard; nb rows of
+        # masked-out entries are dead (gathered then zeroed), so 0 is a
+        # safe in-range index
+        own_dev = np.arange(n_pad, dtype=np.int64) // B
+        same_shard = (nb_g.astype(np.int64) // B) == own_dev[None, :]
+        mask_loc = np.where(same_shard, mask_g, 0)
+        t = filter_face_rows(t, mask_loc, corners)
+        fc_nb_ = np.where(mask_loc > 0, nb_g - (own_dev * B)[None, :],
+                          0).astype(np.int32)
+        fc_nb_ = fc_nb_[:n_regions].T.reshape(D, B, n_regions) \
+            .transpose(0, 2, 1).copy()
+        fc_mask_ = mask_loc[:n_regions].T.reshape(D, B, n_regions) \
+            .transpose(0, 2, 1).copy()
+    else:
+        fdt = np.asarray(t.sign).dtype
+        fc_nb_ = np.zeros((D, 0, B), np.int32)
+        fc_mask_ = np.zeros((D, 0, B), fdt)
 
     dest_s = np.asarray(t.dest_s, np.int64)
     src = np.asarray(t.src_ord, np.int64)
@@ -180,25 +293,13 @@ def shard_tables(t: HaloTables, n_pad: int, mesh: Mesh,
     w = np.asarray(t.w)
     K = idx.shape[1]
 
-    # zero-weight K-padding entries must not create surface demand
-    zmask = (w == 0).all(axis=2)                       # [Gg, K]
+    # remote blocks referenced by each consumer device, plus the
+    # derived row/device arrays (the shared derivation — the padding
+    # audit reads the same one)
+    (remote_by_d, zmask, dev_s, dev_g,
+     src_blk, idx_blk) = _halo_remote_by_d(t, n_pad, D)
 
-    dev_s = (dest_s // LL) // B
-    dev_g = (dest // LL) // B
-    src_blk = src // bs2
-    idx_blk = idx // bs2
-
-    # remote blocks referenced by each consumer device
-    remote_by_d = []
-    for d in range(D):
-        ref = np.concatenate([
-            src_blk[dev_s == d],
-            idx_blk[dev_g == d][~zmask[dev_g == d]],
-        ])
-        remote_by_d.append(
-            np.unique(ref[(ref < d * B) | (ref >= (d + 1) * B)]))
-
-    offsets, S, pack, g2surf = _build_exchange_plan(
+    offsets, S, pack, perms, g2surf = _build_exchange_plan(
         remote_by_d, D, B, n_pad, mode)
 
     def remap_cells(cells, d, dead_local=None):
@@ -269,8 +370,9 @@ def shard_tables(t: HaloTables, n_pad: int, mesh: Mesh,
         idx_l=idx_l_, w_l=w_l_, dest_l=dest_l_,
         src_r=src_r_, sign_r=sign_r_, dest_sr=dest_sr_,
         idx_r=idx_r_, w_r=w_r_, dest_r=dest_r_,
+        fc_nb=fc_nb_, fc_mask=fc_mask_,
         mesh=mesh, B=B, S=S, L=L, g=g, dim=dim,
-        offsets=offsets, mode=mode,
+        offsets=offsets, mode=mode, n_regions=n_regions, perms=perms,
     ))
 
 
@@ -281,26 +383,28 @@ def _put_shard_tables(mesh: Mesh, t):
     return jax.tree_util.tree_unflatten(treedef, put)
 
 
-def _exchange_surface(x_loc, pack, t: "ShardTables"):
+def _exchange_surface(x_loc, pack, t):
     """Surface-block exchange inside shard_map: per-offset ppermute
-    sends (default) or the mesh-wide all-gather (audit mode). Returns
-    the received surface blocks [R, ...] to append after the B own
-    blocks. The ppermute issue order matters for overlap: all sends
-    start before any consumer indexes the results, so XLA can overlap
-    them with the local lab initialization below."""
+    sends (default) or the mesh-wide all-gather (audit mode). ``pack``
+    is the per-device tuple of per-offset send indices ([S_o] each).
+    Returns the received surface blocks [R, ...] (R = sum_o S_o) to
+    append after the B own blocks. Each offset's perm list names only
+    the pairs that actually send (devices outside it receive zeros in
+    that slot); the issue order matters for overlap: all sends start
+    before any consumer indexes the results, so XLA can overlap them
+    with the local lab initialization below."""
     D = t.mesh.devices.size
     if t.mode == "allgather":
         surf = x_loc[pack[0]]                       # [S, dim, bs, bs]
         asurf = jax.lax.all_gather(surf, "x")       # [D, S, ...]
         return asurf.reshape((D * t.S,) + x_loc.shape[1:])
     parts = []
-    for oi, o in enumerate(t.offsets):
-        buf = x_loc[pack[oi]]                       # [S, ...] to owner+o
-        perm = [(e, e + o) for e in range(D) if 0 <= e + o < D]
-        parts.append(jax.lax.ppermute(buf, "x", perm=perm))
+    for oi in range(len(t.offsets)):
+        buf = x_loc[pack[oi]]                       # [S_o, ...]
+        parts.append(jax.lax.ppermute(buf, "x", perm=list(t.perms[oi])))
     if not parts:
         return jnp.zeros((0,) + x_loc.shape[1:], x_loc.dtype)
-    return jnp.concatenate(parts, axis=0)           # [n_off*S, ...]
+    return jnp.concatenate(parts, axis=0)           # [sum S_o, ...]
 
 
 def _assemble_sharded(x: jnp.ndarray, t: ShardTables) -> jnp.ndarray:
@@ -318,23 +422,35 @@ def _assemble_sharded(x: jnp.ndarray, t: ShardTables) -> jnp.ndarray:
     B, L, g, dim = t.B, t.L, t.g, t.dim
     bs = L - 2 * g
 
-    @partial(jax.shard_map, mesh=t.mesh,
-             in_specs=(P("x"),) * 14, out_specs=P("x"))
+    @partial(_shard_map, mesh=t.mesh,
+             in_specs=(P("x"),) * 16, out_specs=P("x"))
     def run(x_loc, pack, src_l, sign_l, dest_sl, idx_l, w_l, dest_l,
-            src_r, sign_r, dest_sr, idx_r, w_r, dest_r):
-        (pack, src_l, sign_l, dest_sl, idx_l, w_l, dest_l,
-         src_r, sign_r, dest_sr, idx_r, w_r, dest_r) = (
-            a[0] for a in (pack, src_l, sign_l, dest_sl, idx_l, w_l,
+            src_r, sign_r, dest_sr, idx_r, w_r, dest_r, fc_nb, fc_mask):
+        pack = tuple(p[0] for p in pack)
+        (src_l, sign_l, dest_sl, idx_l, w_l, dest_l,
+         src_r, sign_r, dest_sr, idx_r, w_r, dest_r,
+         fc_nb, fc_mask) = (
+            a[0] for a in (src_l, sign_l, dest_sl, idx_l, w_l,
                            dest_l, src_r, sign_r, dest_sr, idx_r, w_r,
-                           dest_r))
+                           dest_r, fc_nb, fc_mask))
         # 1. exchange in flight
         recv = _exchange_surface(x_loc, pack, t)
-        # 2. local work: lab init + all local-only rows (x_loc only)
+        # 2. local work: lab init + the shard-local face-copy paint +
+        #    all local-only rows (everything here reads x_loc only, so
+        #    it all sits in the exchange's latency-hiding window)
         flat_l = x_loc.transpose(1, 0, 2, 3).reshape(dim, -1)
         simple_l = flat_l[:, src_l].T * sign_l
         general_l = jnp.einsum("dgk,gkd->gd", flat_l[:, idx_l], w_l)
         labs = jnp.zeros((B, dim, L, L), x_loc.dtype)
         labs = labs.at[:, :, g:g + bs, g:g + bs].set(x_loc)
+        if t.n_regions:
+            # structured same-level strips — the same paint body as the
+            # single-device FastHalo path (halo._paint_regions), over
+            # the own-shard neighbor indices; uncovered blocks write
+            # zeros there and their rows remain in the (filtered)
+            # tables below
+            labs = _paint_regions(x_loc, labs, fc_nb, fc_mask, g, bs,
+                                  t.n_regions == 8)
         lf = labs.transpose(1, 0, 2, 3).reshape(dim, -1)
         lf = jnp.concatenate(
             [lf, jnp.zeros((dim, 1), x_loc.dtype)], axis=1)
@@ -351,7 +467,189 @@ def _assemble_sharded(x: jnp.ndarray, t: ShardTables) -> jnp.ndarray:
 
     return run(x, t.pack, t.src_l, t.sign_l, t.dest_sl, t.idx_l, t.w_l,
                t.dest_l, t.src_r, t.sign_r, t.dest_sr, t.idx_r, t.w_r,
-               t.dest_r)
+               t.dest_r, t.fc_nb, t.fc_mask)
+
+
+def exchange_padding_stats(t: HaloTables, n_pad: int, D: int,
+                           mode: str = "ppermute") -> dict:
+    """Host-only audit of the surface-exchange plan at an ARBITRARY
+    simulated device count (no mesh, no devices): how many blocks the
+    per-offset ppermute buffers actually carry over the wire (the
+    sparse perm pairs x their per-offset buckets) vs the distinct real
+    sends. The old shared-bucket plan grew padding with device count
+    (VERDICT r5 weak #5: 2.64 -> 4.05 MB/device over 8 -> 64 devices
+    on the 1e4-block probe); tests/test_comm_volume.py bounds the
+    ratio so a pod-scale padding regression fails CI instead of
+    passing silently."""
+    assert n_pad % D == 0, (n_pad, D)
+    B = n_pad // D
+    remote_by_d = _halo_remote_by_d(t, n_pad, D)[0]
+    offsets, S, pack, perms, _ = _build_exchange_plan(
+        remote_by_d, D, B, n_pad, mode)
+    # real payload: each (consumer, remote block) demand is exactly
+    # one (owner, offset) send entry in the plan (the plan is BUILT
+    # from remote_by_d, whose per-consumer sets are unique), so the
+    # count needs no re-derivation that could drift from the plan
+    real_blocks = sum(len(r) for r in remote_by_d)
+    if mode == "allgather":
+        padded_blocks = D * S
+    else:
+        padded_blocks = sum(
+            len(perms[oi]) * pack[oi].shape[1]
+            for oi in range(len(offsets)))
+    return {
+        "D": D, "B": B, "S": S, "offsets": offsets,
+        "real_blocks": real_blocks,
+        "padded_blocks": padded_blocks,
+        "ratio": padded_blocks / max(real_blocks, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# structured per-face Poisson operator across shards (round 5 on the mesh)
+# ---------------------------------------------------------------------------
+
+class ShardPoissonOp(NamedTuple):
+    """Per-device rows of flux.PoissonOp behind the surface exchange.
+
+    The structured operator's only data-dependent reads are its 2
+    block-row gathers per face (``nba``/``nbb``); on the mesh those are
+    remapped into the [B own blocks ++ received surface blocks] space
+    of the SAME per-offset ppermute plan the halo gather uses
+    (_build_exchange_plan), so the Krylov loop's per-iteration traffic
+    stays shard-boundary-proportional — no whole-field GSPMD
+    collectives (the reason forest_mesh kept the round-4 lab-table
+    operator until now). The strip math is flux._structured_lap, the
+    ONE body shared with the single-device apply: every tangential
+    matmul reduces over BS only, so per-block-row results are
+    bit-identical across device counts. Exposes ``nba`` so
+    amr._pressure_project's structured-operator dispatch works
+    unchanged on one device and on eight."""
+
+    pack: tuple            # per-offset [D, S_o] int32 own blocks to export
+    nba: jnp.ndarray       # [D, 4, B] int32 into [B own ++ received]
+    nbb: jnp.ndarray       # [D, 4, B]
+    m_same: jnp.ndarray    # [D, 4, B] case one-hots
+    m_coarse: jnp.ndarray  # [D, 4, B]
+    m_fine: jnp.ndarray    # [D, 4, B]
+    m_wall: jnp.ndarray    # [D, 4, B]
+    par: jnp.ndarray       # [D, 4, B]
+    wc0: jnp.ndarray       # [BS, BS] static tangential maps, replicated
+    wc1: jnp.ndarray
+    mcl: jnp.ndarray       # [2, BS, BS]
+    mfr: jnp.ndarray       # [2, BS, BS]
+    d2own: jnp.ndarray     # [BS, BS]
+    mesh: Mesh
+    B: int
+    S: int
+    bs: int
+    offsets: tuple
+    mode: str
+    perms: tuple
+
+    def apply(self, x: jnp.ndarray) -> jnp.ndarray:
+        return _poisson_apply_sharded(x, self)
+
+
+jax.tree_util.register_pytree_node(
+    ShardPoissonOp,
+    lambda t: ((t.pack, t.nba, t.nbb, t.m_same, t.m_coarse, t.m_fine,
+                t.m_wall, t.par, t.wc0, t.wc1, t.mcl, t.mfr, t.d2own),
+               (t.mesh, t.B, t.S, t.bs, t.offsets, t.mode, t.perms)),
+    lambda aux, ch: ShardPoissonOp(*ch, *aux),
+)
+
+
+def shard_poisson_op(op, n_pad: int, mesh: Mesh,
+                     mode: str = "ppermute") -> ShardPoissonOp:
+    """Split a (numpy-leaf) flux.PoissonOp into per-device rows + a
+    surface exchange plan. Surface demand = the live (non-wall,
+    non-pad) neighbor positions of each device's own rows that fall
+    outside its shard — the face-neighbor subset of the halo sets'
+    demand, so S is bounded by the same shard boundary."""
+    D = mesh.devices.size
+    assert n_pad % D == 0, (n_pad, D)
+    B = n_pad // D
+    nba = np.asarray(op.nba, np.int64)          # [4, n_pad]
+    nbb = np.asarray(op.nbb, np.int64)
+    m_same = np.asarray(op.m_same)
+    m_coarse = np.asarray(op.m_coarse)
+    m_fine = np.asarray(op.m_fine)
+    m_wall = np.asarray(op.m_wall)
+    par = np.asarray(op.par)
+    # a gather index is live iff some case mask actually consumes it
+    # (wall/pad faces keep the n_real sentinel — dead, remapped to 0)
+    live_a = (m_same + m_coarse + m_fine) > 0   # [4, n_pad]
+    live_b = m_fine > 0                         # nbb only feeds g_fine
+
+    remote_by_d = []
+    for d in range(D):
+        sl = slice(d * B, (d + 1) * B)
+        refs = np.concatenate([nba[:, sl][live_a[:, sl]],
+                               nbb[:, sl][live_b[:, sl]]])
+        remote_by_d.append(
+            np.unique(refs[(refs < d * B) | (refs >= (d + 1) * B)]))
+
+    offsets, S, pack, perms, g2surf = _build_exchange_plan(
+        remote_by_d, D, B, n_pad, mode)
+
+    def remap(pos, live, d):
+        local = (pos >= d * B) & (pos < (d + 1) * B)
+        sidx = g2surf[d, np.clip(pos, 0, n_pad - 1)]
+        out = np.where(local, pos - d * B, B + sidx)
+        out = np.where(live, out, 0)
+        assert not (live & ~local & (sidx < 0)).any(), \
+            "gather source missing from surface set"
+        return out
+
+    nba_l = np.zeros((D, 4, B), np.int32)
+    nbb_l = np.zeros((D, 4, B), np.int32)
+    for d in range(D):
+        sl = slice(d * B, (d + 1) * B)
+        nba_l[d] = remap(nba[:, sl], live_a[:, sl], d)
+        nbb_l[d] = remap(nbb[:, sl], live_b[:, sl], d)
+
+    def per_dev(a):
+        return np.ascontiguousarray(
+            np.asarray(a).reshape(4, D, B).transpose(1, 0, 2))
+
+    shard = NamedSharding(mesh, P("x"))
+    repl = NamedSharding(mesh, P())
+    pack = jax.device_put(list(pack), [shard] * len(pack))
+    rows = jax.device_put(
+        [nba_l, nbb_l, per_dev(m_same), per_dev(m_coarse),
+         per_dev(m_fine), per_dev(m_wall), per_dev(par)], [shard] * 7)
+    mats = jax.device_put(
+        [np.asarray(op.wc0), np.asarray(op.wc1), np.asarray(op.mcl),
+         np.asarray(op.mfr), np.asarray(op.d2own)], [repl] * 5)
+    return ShardPoissonOp(tuple(pack), *rows, *mats, mesh=mesh, B=B,
+                          S=S, bs=int(np.asarray(op.wc0).shape[0]),
+                          offsets=offsets, mode=mode, perms=perms)
+
+
+def _poisson_apply_sharded(x: jnp.ndarray, t: ShardPoissonOp):
+    """A(x) for [n_pad, BS, BS] ordered x sharded on the block axis:
+    issue the surface exchange, then run the shared structured strip
+    math over [own ++ received] gather space. The own-edge strips and
+    the within-block 5-point part read x_loc only, so they sit in the
+    exchange's latency-hiding window exactly like the halo assembly's
+    local rows."""
+    from ..flux import _structured_lap
+
+    @partial(_shard_map, mesh=t.mesh,
+             in_specs=(P("x"),) * 9 + (P(),) * 5, out_specs=P("x"))
+    def run(x_loc, pack, nba, nbb, ms, mc, mf, mw, par,
+            wc0, wc1, mcl, mfr, d2own):
+        pack = tuple(p[0] for p in pack)
+        nba, nbb, ms, mc, mf, mw, par = (
+            a[0] for a in (nba, nbb, ms, mc, mf, mw, par))
+        recv = _exchange_surface(x_loc, pack, t)
+        blocks = jnp.concatenate([x_loc, recv], axis=0)
+        return _structured_lap(x_loc, blocks, nba, nbb, ms, mc, mf, mw,
+                               par, (wc0, wc1, mcl, mfr, d2own))
+
+    return run(x, t.pack, t.nba, t.nbb, t.m_same, t.m_coarse, t.m_fine,
+               t.m_wall, t.par, t.wc0, t.wc1, t.mcl, t.mfr, t.d2own)
 
 
 # ---------------------------------------------------------------------------
@@ -364,7 +662,7 @@ class ShardFluxCorr(NamedTuple):
     dests are local cells [B*BS*BS] ++ 1 scratch. Exchange modes as in
     ShardTables."""
 
-    pack: jnp.ndarray    # [D, n_off, S] own blocks whose deposits export
+    pack: tuple          # per-offset [D, S_o] blocks whose deposits export
     dest: jnp.ndarray    # [D, M]
     cidx: jnp.ndarray    # [D, M]
     fidx1: jnp.ndarray   # [D, M]
@@ -376,6 +674,7 @@ class ShardFluxCorr(NamedTuple):
     bs: int
     offsets: tuple
     mode: str
+    perms: tuple
 
     def apply(self, values, deposits):
         return _apply_corr_sharded(values, deposits, self)
@@ -384,7 +683,7 @@ class ShardFluxCorr(NamedTuple):
 jax.tree_util.register_pytree_node(
     ShardFluxCorr,
     lambda t: ((t.pack, t.dest, t.cidx, t.fidx1, t.fidx2, t.valid),
-               (t.mesh, t.B, t.S, t.bs, t.offsets, t.mode)),
+               (t.mesh, t.B, t.S, t.bs, t.offsets, t.mode, t.perms)),
     lambda aux, ch: ShardFluxCorr(*ch, *aux),
 )
 
@@ -410,7 +709,7 @@ def shard_flux_corr(corr, n_pad: int, mesh: Mesh, bs: int,
         remote_by_d.append(
             np.unique(ref[(ref < d * B) | (ref >= (d + 1) * B)]))
 
-    offsets, S, pack, g2surf = _build_exchange_plan(
+    offsets, S, pack, perms, g2surf = _build_exchange_plan(
         remote_by_d, D, B, n_pad, mode)
 
     def remap_dep(cells, d):
@@ -440,7 +739,7 @@ def shard_flux_corr(corr, n_pad: int, mesh: Mesh, bs: int,
     return _put_shard_tables(mesh, ShardFluxCorr(
         pack=pack, dest=pk_dest, cidx=pk_c, fidx1=pk_f1, fidx2=pk_f2,
         valid=pk_v, mesh=mesh, B=B, S=S, bs=bs,
-        offsets=offsets, mode=mode,
+        offsets=offsets, mode=mode, perms=perms,
     ))
 
 
@@ -448,11 +747,12 @@ def _apply_corr_sharded(values, deposits, t: ShardFluxCorr):
     B, bs = t.B, t.bs
     vec = values.ndim == 4
 
-    @partial(jax.shard_map, mesh=t.mesh,
+    @partial(_shard_map, mesh=t.mesh,
              in_specs=(P("x"),) * 8, out_specs=P("x"))
     def run(v_loc, d_loc, pack, dest, cidx, f1, f2, valid):
-        pack, dest, cidx, f1, f2, valid = (
-            a[0] for a in (pack, dest, cidx, f1, f2, valid))
+        pack = tuple(p[0] for p in pack)
+        dest, cidx, f1, f2, valid = (
+            a[0] for a in (dest, cidx, f1, f2, valid))
         recv = _exchange_surface(d_loc, pack, t)
         dep = jnp.concatenate([d_loc, recv], axis=0)
         if vec:

@@ -68,9 +68,15 @@ def main():
         # the replicated prolongation tables
         for name in sorted(sim._tables):
             t = sim._tables[name]
-            if hasattr(t, "pack"):      # ShardTables
-                for leaf in (t.pack, t.src_l, t.dest_sl, t.dest_l,
-                             t.src_r, t.dest_sr, t.dest_r):
+            if hasattr(t, "nba") and hasattr(t, "pack"):
+                # ShardPoissonOp (the sharded structured operator)
+                for leaf in (*t.pack, t.nba, t.nbb):
+                    h.update(np.asarray(
+                        sim._pull_blockwise(leaf)).tobytes())
+            elif hasattr(t, "pack"):    # ShardTables
+                for leaf in (*t.pack, t.src_l, t.dest_sl, t.dest_l,
+                             t.src_r, t.dest_sr, t.dest_r,
+                             t.fc_nb, t.fc_mask):
                     h.update(np.asarray(
                         sim._pull_blockwise(leaf)).tobytes())
             else:                        # replicated HaloTables

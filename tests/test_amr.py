@@ -523,7 +523,9 @@ def test_production_two_level_trigger():
 def test_twolevel_env_gate_rejects_typos(monkeypatch):
     """CUP2D_TWOLEVEL typos must raise, not silently fall back — an
     A/B probe that measures the same form on both arms reports the
-    additive speedup as gone (code-review r5)."""
+    additive speedup as gone (code-review r5). Since the gate latch
+    moved to __init__ (ADVICE r5), the typo fails at CONSTRUCTION —
+    before any step runs at the wrong form."""
     import pytest as _pytest
 
     from cup2d_tpu.amr import AMRSim
@@ -532,6 +534,5 @@ def test_twolevel_env_gate_rejects_typos(monkeypatch):
     monkeypatch.setenv("CUP2D_TWOLEVEL", "add")
     cfg = SimConfig(bpdx=1, bpdy=1, level_max=2, level_start=1,
                     extent=1.0, dtype="float64")
-    sim = AMRSim(cfg, shapes=[])
     with _pytest.raises(ValueError, match="CUP2D_TWOLEVEL"):
-        sim.step_once(dt=1e-3)
+        AMRSim(cfg, shapes=[])

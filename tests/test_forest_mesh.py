@@ -108,3 +108,129 @@ def test_sharded_forest_matches_single_device(ndev):
     a = np.asarray(ref.forest.fields["vel"][ref.forest.order()])
     b = np.asarray(sh.forest.fields["vel"][sh.forest.order()])
     assert np.abs(a - b).max() < 1e-11
+
+
+def _mixed_three_level_forest():
+    """Walls, same-level faces/corners, coarse and fine interfaces —
+    the same topology zoo tests/test_flux.py pins the single-device
+    fast ops on."""
+    from cup2d_tpu.forest import Forest
+
+    cfg = SimConfig(bpdx=2, bpdy=3, level_max=4, level_start=1,
+                    extent=1.0, dtype="float64")
+    f = Forest(cfg)
+    f.release(1, 0, 0)
+    for a in (0, 1):
+        for b in (0, 1):
+            f.allocate(2, a, b)
+    f.release(2, 0, 0)
+    for a in (0, 1):
+        for b in (0, 1):
+            f.allocate(3, a, b)
+    f.release(1, 3, 5)
+    for a in (0, 1):
+        for b in (0, 1):
+            f.allocate(2, 6 + a, 10 + b)
+    return cfg, f
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_shard_fast_paint_matches_table_assembly():
+    """The shard-local FastHalo paint must reproduce the gather-table
+    assembly BIT-EXACTLY on a mixed three-level forest — the same bar
+    tests/test_flux.py sets for the single-device paint (round-5 fast
+    path on the mesh)."""
+    from cup2d_tpu.halo import (
+        assemble_labs_ordered,
+        build_face_copy,
+        build_tables,
+        pad_tables,
+    )
+    from cup2d_tpu.parallel.shard_halo import shard_tables
+
+    cfg, f = _mixed_three_level_forest()
+    order = f.order()
+    n = len(order)
+    n_pad = 40                                 # divides the 8-mesh
+    assert n < n_pad
+    mesh = make_mesh(8)
+    nb, mask = build_face_copy(f, order, n_pad)
+    assert mask.sum() > 0
+    rng = np.random.default_rng(5)
+    for (g, tensorial, dim, corners) in ((3, True, 2, True),
+                                         (1, False, 2, False),
+                                         (1, True, 1, True)):
+        x = rng.standard_normal((n_pad, dim, cfg.bs, cfg.bs))
+        x[n:] = 0.0
+        xj = jnp.asarray(x)
+        t = build_tables(f, order, g, tensorial, dim)
+        want = np.asarray(assemble_labs_ordered(
+            xj, jax.device_put(pad_tables(t, n_pad))))
+        st = shard_tables(t, n_pad, mesh, fc=(nb, mask),
+                          corners=corners)
+        # the paint actually engages on at least one shard
+        assert float(np.asarray(st.fc_mask).sum()) > 0
+        got = np.asarray(st.assemble(xj))
+        np.testing.assert_array_equal(
+            got[:n], want[:n],
+            err_msg=f"g={g} tensorial={tensorial} dim={dim}")
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_shard_poisson_structured_matches_single_device():
+    """The sharded structured PoissonOp closure must match the
+    single-device structured operator to <= 1e-12 on a mixed-level
+    forest (it is bit-identical by construction: shared strip math,
+    per-face matmuls reduce over BS only)."""
+    from cup2d_tpu.flux import build_poisson_structured, \
+        poisson_apply_structured
+    from cup2d_tpu.parallel.shard_halo import ShardPoissonOp, \
+        shard_poisson_op
+
+    cfg, f = _mixed_three_level_forest()
+    order = f.order()
+    n = len(order)
+    n_pad = 40
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((n_pad, cfg.bs, cfg.bs))
+    x[n:] = 0.0
+    xj = jnp.asarray(x)
+    op = build_poisson_structured(f, order, n_pad)
+    want = np.asarray(poisson_apply_structured(xj, op))
+    sop = shard_poisson_op(op, n_pad, mesh)
+    assert isinstance(sop, ShardPoissonOp)
+    assert sop.S < n_pad            # surface stays boundary-sized
+    got = np.asarray(poisson_apply_structured(xj, sop))
+    np.testing.assert_allclose(got[:n], want[:n], rtol=0, atol=1e-12)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_sharded_sim_wires_fast_ops():
+    """ShardedAMRSim must actually WIRE the round-5 fast operators into
+    its hot-loop tables (a silent fallback to the round-4 lab-table
+    forms would erase the per-device speedup without failing anything),
+    and CUP2D_POIS=tables must restore the table form for A/B runs."""
+    from cup2d_tpu.parallel.shard_halo import ShardPoissonOp, ShardTables
+
+    mesh = make_mesh(8)
+    sh = ShardedAMRSim(_mixed_cfg(), mesh)
+    sh._refresh()
+    assert isinstance(sh._tables["pois"], ShardPoissonOp)
+    for k, corners in sh._FAST_SETS.items():
+        t = sh._tables.get(k)
+        if t is None:
+            continue
+        assert isinstance(t, ShardTables), k
+        assert t.n_regions == (8 if corners else 4), (k, t.n_regions)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_sharded_pois_tables_env_fallback(monkeypatch):
+    from cup2d_tpu.parallel.shard_halo import ShardTables
+
+    monkeypatch.setenv("CUP2D_POIS", "tables")
+    mesh = make_mesh(8)
+    sh = ShardedAMRSim(_mixed_cfg(), mesh)
+    sh._refresh()
+    assert isinstance(sh._tables["pois"], ShardTables)

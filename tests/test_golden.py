@@ -15,7 +15,8 @@ import os
 import numpy as np
 import pytest
 
-from validation.golden import CHECK_STEPS, GOLDEN_PATH, run_trajectory
+from validation.golden import CHECK_STEPS, GOLDEN_PATH, MID_STEP, \
+    run_trajectory
 
 
 @pytest.mark.skipif(not os.path.exists(GOLDEN_PATH),
@@ -55,6 +56,25 @@ def test_golden_canonical_trajectory():
                                   ("omega", 0.8)):
                     assert abs(fg[name] - fw[name]) <= tol, \
                         (f"step {step} fish {k} {name} (coarse): "
+                         f"{fg[name]} vs {fw[name]}")
+            continue
+        if step == str(MID_STEP):
+            # mid-trajectory (pre-chaotic, just after the impulse):
+            # INTERMEDIATE tolerances — 4+ orders tighter than the
+            # final-step windows, so a late-window trajectory fork
+            # still fails here, but loose enough that benign
+            # instruction-order changes across XLA releases pass
+            # without a re-golden (ADVICE r5)
+            np.testing.assert_allclose(g["umax"], w["umax"],
+                                       rtol=1e-3, atol=1e-9)
+            for k, (fg, fw) in enumerate(zip(g["fish"], w["fish"])):
+                np.testing.assert_allclose(
+                    fg["com"], fw["com"], rtol=0, atol=1e-4,
+                    err_msg=f"step {step} fish {k} CoM (mid)")
+                for name, tol in (("u", 5e-3), ("v", 5e-3),
+                                  ("omega", 5e-2)):
+                    assert abs(fg[name] - fw[name]) <= tol, \
+                        (f"step {step} fish {k} {name} (mid): "
                          f"{fg[name]} vs {fw[name]}")
             continue
         # early steps: f64 on CPU is deterministic; the loose-ish floors
