@@ -15,6 +15,17 @@ reference. Extra flags beyond the reference: ``-level N`` (force a
 single-resolution uniform run at level N), ``-dtype``, ``-output DIR``,
 ``-checkpointEvery N``, ``-restart DIR``, ``-maxSteps N``, ``-profile``
 (per-phase timer report + cells*steps/s at exit).
+
+The run loop is SUPERVISED (resilience.py): every step's health verdict
+rides the diagnostics the step already pulls, a bad step walks the
+rewind/escalate/disk-restore/abort ladder, SIGTERM checkpoints at the
+next step boundary and exits 0, and every recovery lands in
+``<output>/events.jsonl``. Knobs: ``-noSupervise`` (verdict-only: first
+bad step aborts — still with a post-mortem checkpoint, unlike the old
+inline NaN check), ``-guardRing K`` (good-state ring depth, default 2),
+``-eventLog PATH``. Fault drills: the ``CUP2D_FAULTS`` env var
+(faults.py) injects NaNs, solver give-ups, mid-save crashes and
+SIGTERMs on schedule.
 """
 
 from __future__ import annotations
@@ -40,6 +51,17 @@ def main(argv=None) -> int:
         else 0
     max_steps = p("maxSteps").asInt() if p.has("maxSteps") else 10**9
     os.makedirs(outdir, exist_ok=True)
+
+    from . import faults
+    from .resilience import EventLog, PreemptionGuard, ResilienceAbort, \
+        StepGuard, set_event_log
+
+    plan = faults.FaultPlan.from_env()   # CUP2D_FAULTS, latched once
+    faults.install(plan)                 # io.py's crash window consults it
+    events_path = p("eventLog").asString() if p.has("eventLog") \
+        else os.path.join(outdir, "events.jsonl")
+    log = EventLog(events_path)
+    set_event_log(log)                   # io/launch fallback events
 
     if uniform:
         from .sim import Simulation
@@ -74,28 +96,66 @@ def main(argv=None) -> int:
             sim.sync_fields()
             dump_forest(path, sim.time, sim.forest)
 
-    next_dump = sim.time if cfg.dump_time > 0 else float("inf")
-    while sim.time < cfg.end_time and sim.step_count < max_steps:
-        if sim.step_count % 5 == 0:
-            print(f"cup2d_tpu: {sim.step_count:08d} t={sim.time:.6f}",
-                  file=sys.stderr)
-        if cfg.dump_time > 0 and sim.time >= next_dump:
-            # catch the schedule up even when dt > tdump (the reference
-            # falls permanently behind there, main.cpp:6597-6602)
-            while next_dump <= sim.time:
-                next_dump += cfg.dump_time
-            dump(os.path.join(outdir, f"vel.{sim.step_count:08d}"))
-        if not uniform and (sim.step_count <= 10
-                            or sim.step_count % cfg.adapt_steps == 0):
-            sim.adapt()
-        diag = sim.step_once()
-        if float(diag.get("umax", 0.0)) != float(diag.get("umax", 0.0)):
-            print("cup2d_tpu: NaN velocity, aborting", file=sys.stderr)
-            return 1
-        if ckpt_every and sim.step_count % ckpt_every == 0:
-            save_checkpoint(os.path.join(outdir, "checkpoint"), sim)
+    ckpt_path = os.path.join(outdir, "checkpoint")
+    guard = StepGuard(
+        sim,
+        ring=p("guardRing").asInt() if p.has("guardRing") else 1,
+        ckpt_dir=ckpt_path,
+        postmortem_dir=os.path.join(outdir, "postmortem"),
+        event_log=log,
+        faults=plan,
+        recover=not p.has("noSupervise"),
+    )
+    # SIGTERM = preemption notice: finish the step in flight, write the
+    # restart point, exit 0 (the grace window buys a checkpoint, not a
+    # corpse). Installed around the loop only — library users keep
+    # their own handlers.
+    stop = PreemptionGuard().install()
 
-    sim.force_log.close()
+    rc = 0
+    try:
+        next_dump = sim.time if cfg.dump_time > 0 else float("inf")
+        while sim.time < cfg.end_time and sim.step_count < max_steps:
+            if stop.triggered:
+                save_checkpoint(ckpt_path, sim)
+                log.emit(event="sigterm_checkpoint", step=sim.step_count,
+                         sim_time=sim.time, path=ckpt_path,
+                         signum=stop.signum)
+                print(f"cup2d_tpu: SIGTERM at step {sim.step_count} — "
+                      f"checkpoint written to {ckpt_path}, exiting "
+                      "cleanly", file=sys.stderr)
+                return 0
+            if sim.step_count % 5 == 0:
+                print(f"cup2d_tpu: {sim.step_count:08d} t={sim.time:.6f}",
+                      file=sys.stderr)
+            if cfg.dump_time > 0 and sim.time >= next_dump:
+                # catch the schedule up even when dt > tdump (the
+                # reference falls permanently behind there,
+                # main.cpp:6597-6602)
+                while next_dump <= sim.time:
+                    next_dump += cfg.dump_time
+                dump(os.path.join(outdir, f"vel.{sim.step_count:08d}"))
+            if not uniform and (sim.step_count <= 10
+                                or sim.step_count % cfg.adapt_steps == 0):
+                sim.adapt()
+            guard.step()
+            if ckpt_every and sim.step_count % ckpt_every == 0:
+                save_checkpoint(ckpt_path, sim)
+    except ResilienceAbort as e:
+        # the guard already wrote the post-mortem checkpoint, emitted
+        # the abort event and closed the force log
+        print(f"cup2d_tpu: unrecoverable step failure — {e}",
+              file=sys.stderr)
+        rc = 1
+    finally:
+        stop.uninstall()
+        if sim.force_log is not None and not sim.force_log.closed:
+            sim.force_log.close()
+        set_event_log(None)
+        log.close()
+    if rc:
+        return rc
+
     if not uniform:
         sim.sync_fields()   # leave the slot fields dict current
     if sim.timers is not None:

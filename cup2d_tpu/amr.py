@@ -226,6 +226,9 @@ class AMRSim(ShapeHostMixin):
         self._last_iters = 0
         self._last_iters_dev = None
         self._coarse_on = False
+        # StepGuard's escalation rung forces the exact (tol-0 + coarse
+        # correction) Poisson solve on a retried step (resilience.py)
+        self._force_exact = False
         self._raster_jit = jax.jit(self._rasterize_impl)
         self._vorticity_jit = jax.jit(self._vorticity_impl)
         self._tags_jit = jax.jit(self._tags_impl)
@@ -423,6 +426,16 @@ class AMRSim(ShapeHostMixin):
             ntx = f.cfg.bpdx << l
             nty = f.cfg.bpdy << l
             sel = lvo == l
+            if not np.any(sel):
+                # empty ladder level: never emit an entry — the
+                # _deposit/_interp chains in _pressure_project bound
+                # their image ladders by min/max of THIS dict, so an
+                # empty level above the finest active one would force
+                # full-domain O(4^level) images for blocks that do not
+                # exist (ADVICE r5). np.unique of the active levels
+                # cannot produce one today; this guard keeps the
+                # invariant explicit for future callers.
+                continue
             tix = bjo[sel] * ntx + bio[sel]
             # tiles owned by no level-l block gather the first pad row
             # (index n_real points into the pad range: n_pad > n_real)
@@ -655,6 +668,19 @@ class AMRSim(ShapeHostMixin):
             ncy, ncx = self._coarse_shape
             c = self._coarse_level
             bs = cfg.bs
+            # ladder bounds: ``lev`` holds ONLY levels with active
+            # blocks (_build_coarse_maps filters empty ones), so the
+            # image chains below stop at the finest/coarsest ACTIVE
+            # level — a deeply compressed levelMax-8 forest never
+            # materializes finest-cap (~8M-cell) images per M
+            # application (ADVICE r5: skip empty ladder levels above
+            # the finest active one). Remaining scaling cliff,
+            # documented rather than paid for: each NON-empty level
+            # still paints a FULL-DOMAIN image at its own resolution —
+            # O(4^level) cells even when a single block is active
+            # there. Cropping to the active-tile bounding box is the
+            # next step if deep-refinement cases appear (ROADMAP open
+            # item).
             lmin_p = min(lev)
             lmax_p = max(lev)
             cih2 = jnp.where(hsq > 0,
@@ -685,15 +711,21 @@ class AMRSim(ShapeHostMixin):
                 return rc
 
             def _interp(ec, like):
-                imgs = {c: ec}
+                # images are kept ONLY for levels with active blocks;
+                # gap levels inside [lmin_p, lmax_p] still pay their
+                # ladder step (the 2x chain is how level l+1 is built
+                # from l) but are never stored or extracted
+                imgs = {c: ec} if c in lev else {}
                 a = ec
                 for l in range(c + 1, lmax_p + 1):
                     a = _up2_bilinear(a)
-                    imgs[l] = a
+                    if l in lev:
+                        imgs[l] = a
                 a = ec
                 for l in range(c - 1, lmin_p - 1, -1):
                     a = _down2_mean(a)
-                    imgs[l] = a
+                    if l in lev:
+                        imgs[l] = a
                 e = jnp.zeros_like(like)
                 for l in sorted(lev):
                     own, ownm, tid, selp = lev[l]
@@ -783,6 +815,18 @@ class AMRSim(ShapeHostMixin):
         v = (v + dv * ih2) * maskv
         return v, p_new[:, None], res
 
+    @staticmethod
+    def _finite_flag(v, p_new, maskv):
+        """Fused isfinite reduction over velocity + pressure — the
+        health verdict's NaN/Inf detector (resilience.health_verdict),
+        riding the step's existing diag pull. ``v`` is already masked
+        (pad rows zeroed by the step); the pressure's pad rows hold
+        stale-but-finite garbage by the padding invariant, masked here
+        through a where (a multiply would turn a hypothetical pad Inf
+        into NaN and false-positive)."""
+        return jnp.all(jnp.isfinite(v)) & jnp.all(
+            jnp.isfinite(jnp.where(maskv > 0, p_new, 0.0)))
+
     # ------------------------------------------------------------------
     # device step: obstacle-free (the oracle path)
     # ------------------------------------------------------------------
@@ -797,6 +841,8 @@ class AMRSim(ShapeHostMixin):
             "poisson_iters": res.iters,
             "poisson_residual": res.residual,
             "poisson_stalled": res.stalled,
+            "poisson_converged": res.converged,
+            "finite": self._finite_flag(v, p_new, maskv),
             "umax": jnp.max(jnp.abs(v)),
         }
         return v, p_new, diag
@@ -865,6 +911,8 @@ class AMRSim(ShapeHostMixin):
             "poisson_iters": res.iters,
             "poisson_residual": res.residual,
             "poisson_stalled": res.stalled,
+            "poisson_converged": res.converged,
+            "finite": self._finite_flag(v, p_new, maskv),
             "umax": jnp.max(jnp.abs(v)),
         }
         return v, p_new, uvw, diag
@@ -1400,7 +1448,7 @@ class AMRSim(ShapeHostMixin):
             elif self._last_iters_dev is not None:
                 # explicit-dt callers still drain the iters scalar
                 self._float_pull(jnp.zeros((), f.dtype))
-            exact = self.step_count < 10
+            exact = self.step_count < 10 or self._force_exact
             with tm.phase("flow"):
                 vel, pres, diag = self._step_jit(
                     ordf["vel"], ordf["pres"],
@@ -1485,7 +1533,7 @@ class AMRSim(ShapeHostMixin):
 
         prescribed = jnp.asarray(
             [[s.u, s.v, s.omega] for s in self.shapes], dtype=f.dtype)
-        exact = self.step_count < 10
+        exact = self.step_count < 10 or self._force_exact
         with_forces = bool(
             self.compute_forces_every
             and self.step_count % self.compute_forces_every == 0)

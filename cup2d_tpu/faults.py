@@ -1,0 +1,163 @@
+"""Fault injection for the resilience subsystem (tests + chaos drills).
+
+The supervised run loop (`resilience.StepGuard`) promises a bounded
+recovery ladder for the failures the reference simply dies on. A
+promise like that is only real if every rung can be *exercised*; this
+module provides the controlled failures that do it, both from tests
+(construct a :class:`FaultPlan` directly) and from the CLI/environment
+(``CUP2D_FAULTS``, latched ONCE at plan construction — this module is a
+SANCTIONED env latch point, enforced by ``tests/test_env_latch.py``).
+
+Spec syntax — comma-separated directives, ``name[@STEP][*COUNT]``::
+
+    nan_vel@N[*K]         poison the velocity with NaN before (up to K)
+                          attempts of step N — the verdict's isfinite
+                          reduction must catch it and the guard rewind
+    inf_vel@N[*K]         same with +Inf (the pre-guard driver check
+                          ``umax != umax`` famously missed Inf)
+    poisson_giveup@N[*K]  report step N's pressure solve as failed
+                          (forced BiCGSTAB give-up seen by the verdict)
+    sigterm@N             deliver SIGTERM to this process after step N
+                          completes (preemption mid-run)
+    crash_in_save         raise :class:`InjectedCrash` between the
+                          checkpoint park and install renames
+                          (io.save_checkpoint's crash window)
+
+``*K`` repeats the fault for K consecutive attempts of that step, which
+is how a test climbs the ladder: ``*1`` recovers at the rewind-retry
+rung, ``*2`` forces the exact-Poisson escalation, ``*3`` the disk
+restore, ``*4`` (with no disk checkpoint: ``*2``) the abort rung.
+
+A typo'd directive raises instead of silently arming nothing — the
+same principle as the CUP2D_POIS/CUP2D_TWOLEVEL gate validation
+(a fault drill that never fires measures nothing).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Optional
+
+
+class InjectedCrash(RuntimeError):
+    """Raised at an armed crash point (stands in for a hard kill)."""
+
+
+class FaultPlan:
+    """Parsed, consumable fault schedule. Each directive is consumed as
+    it fires (a decrementing count), so a recovered retry does not
+    re-fault unless the spec asked for it with ``*K``."""
+
+    _POISON = {"nan_vel": float("nan"), "inf_vel": float("inf")}
+
+    def __init__(self, spec: str = ""):
+        self.vel_poison: dict[int, list] = {}   # step -> [value, count]
+        self.giveup: dict[int, int] = {}        # step -> count
+        self.sigterm_steps: set[int] = set()
+        self.crash_points: dict[str, int] = {}  # name -> count
+        for tok in (spec or "").split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            count = 1
+            if "*" in tok:
+                tok, c = tok.split("*", 1)
+                count = int(c)
+            if "@" in tok:
+                name, s = tok.split("@", 1)
+                step: Optional[int] = int(s)
+            else:
+                name, step = tok, None
+            if name in self._POISON:
+                if step is None:
+                    raise ValueError(f"{name} needs @STEP")
+                self.vel_poison[step] = [self._POISON[name], count]
+            elif name == "poisson_giveup":
+                if step is None:
+                    raise ValueError("poisson_giveup needs @STEP")
+                self.giveup[step] = count
+            elif name == "sigterm":
+                if step is None:
+                    raise ValueError("sigterm needs @STEP")
+                self.sigterm_steps.add(step)
+            elif name == "crash_in_save":
+                self.crash_points["checkpoint_install"] = count
+            else:
+                raise ValueError(
+                    f"unknown fault directive {name!r} "
+                    "(expected nan_vel|inf_vel|poisson_giveup|"
+                    "sigterm|crash_in_save)")
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        """Latch CUP2D_FAULTS once (the sanctioned read site)."""
+        return cls(os.environ.get("CUP2D_FAULTS", ""))
+
+    def __bool__(self) -> bool:
+        return bool(self.vel_poison or self.giveup or self.sigterm_steps
+                    or self.crash_points)
+
+    # -- hooks consulted by the guard / io ----------------------------
+    def apply_pre_step(self, sim) -> bool:
+        """Poison the velocity before an attempt of the current step.
+        Returns whether a fault fired (and consumed one count)."""
+        ent = self.vel_poison.get(sim.step_count)
+        if not ent or ent[1] <= 0:
+            return False
+        ent[1] -= 1
+        poison_velocity(sim, ent[0])
+        return True
+
+    def poisson_giveup_at(self, step: int) -> bool:
+        """Consume one forced-give-up count for ``step`` if armed."""
+        c = self.giveup.get(step, 0)
+        if c <= 0:
+            return False
+        self.giveup[step] = c - 1
+        return True
+
+    def fire_post_step(self, step: int) -> None:
+        """Post-step faults: SIGTERM delivery (preemption)."""
+        if step in self.sigterm_steps:
+            self.sigterm_steps.discard(step)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def fire_crash_point(self, name: str) -> None:
+        c = self.crash_points.get(name, 0)
+        if c > 0:
+            self.crash_points[name] = c - 1
+            raise InjectedCrash(name)
+
+
+def poison_velocity(sim, value: float) -> None:
+    """Write ``value`` into one velocity cell of a REAL block/cell
+    through each driver's supported write path (the ordered working
+    state on the forest — slot writes between steps would trip the
+    _ord_dirty guard; the FlowState on the uniform drivers)."""
+    if hasattr(sim, "forest"):
+        ordf = sim._ordered_state()
+        sim._set_ordered(vel=ordf["vel"].at[0, 0, 0, 0].set(value))
+    else:
+        sim.state = sim.state._replace(
+            vel=sim.state.vel.at[0, 0, 0].set(value))
+
+
+# -- process-wide plan (the CLI arms it; io.py's crash window asks) ---
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    global _ACTIVE
+    _ACTIVE = plan
+
+
+def active() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def crash_point(name: str) -> None:
+    """No-op unless a plan armed this crash point (io.py calls this
+    between the checkpoint park and install renames)."""
+    if _ACTIVE is not None:
+        _ACTIVE.fire_crash_point(name)

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+from typing import NamedTuple
 
 import numpy as np
 
@@ -39,11 +40,18 @@ def _multihost() -> bool:
 
 def _to_host_global(x) -> np.ndarray:
     """Full host copy of a (possibly cross-host-sharded) array. A
-    COLLECTIVE on pods: every process must call it, in the same order."""
+    COLLECTIVE on pods: every process must call it, in the same order.
+
+    Must be an OWNING copy, never a view: np.asarray of a CPU-backend
+    jax array is zero-copy, and the StepGuard's snapshot ring
+    (resilience.py) holds these arrays across steps whose jits DONATE
+    the state buffers — a view into a donated buffer dangles once XLA
+    reuses the memory (observed as heap corruption in the supervised
+    CLI loop)."""
     if _multihost():
         from jax.experimental import multihost_utils
         return np.asarray(multihost_utils.process_allgather(x, tiled=True))
-    return np.asarray(x)
+    return np.array(x)
 
 
 def _is_writer() -> bool:
@@ -181,17 +189,13 @@ def read_dump(path: str):
 # checkpoint / restore (beyond-parity, SURVEY.md §5)
 # ---------------------------------------------------------------------------
 
-def save_checkpoint(dirpath: str, sim) -> None:
-    """Serialize a Simulation (or UniformSim) to ``dirpath``.
-
-    Written to a sibling temp dir and renamed into place so a crash
-    mid-save (the very event checkpointing exists for) can't destroy the
-    previous restart point. On a multi-host pod this is a COLLECTIVE:
-    every process must call it (the field gathers are all-gathers);
-    process 0 alone writes, to storage all processes can read back
-    (the reference's MPI-IO dump makes the same shared-FS assumption),
-    and a barrier keeps the others from racing past an incomplete
-    save."""
+def _gather_state(sim):
+    """Collect the full checkpoint payload (host numpy fields) + meta
+    dict. The shared gather half of ``save_checkpoint`` and the
+    StepGuard's in-RAM snapshots (resilience.py) — one machinery, so a
+    ring rewind restores EXACTLY what a disk restore would. COLLECTIVE
+    on pods (field all-gathers); every process must call it in the same
+    order."""
     if hasattr(sim, "sync_fields"):
         # the adaptive driver's per-step truth is its ordered working
         # state; flush it into the slot-layout dict read below
@@ -214,18 +218,6 @@ def save_checkpoint(dirpath: str, sim) -> None:
     else:
         payload = {k: _to_host_global(v)
                    for k, v in sim.state._asdict().items()}
-    if not _is_writer():
-        _sync_processes("save_checkpoint")
-        return
-    tmp = dirpath.rstrip("/") + ".tmp"
-    if os.path.exists(tmp):
-        import shutil
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
-    np.savez(os.path.join(tmp, "fields.npz"), **payload)
-    shapes = getattr(sim, "shapes", [])
-    with open(os.path.join(tmp, "shapes.pkl"), "wb") as f:
-        pickle.dump(shapes, f)
     meta = {
         "time": sim.time,
         "step_count": sim.step_count,
@@ -269,6 +261,33 @@ def save_checkpoint(dirpath: str, sim) -> None:
             "coarse_on": bool(sim._coarse_on),
             "last_iters": int(sim._last_iters),
         }
+    return payload, meta
+
+
+def save_checkpoint(dirpath: str, sim) -> None:
+    """Serialize a Simulation (or UniformSim) to ``dirpath``.
+
+    Written to a sibling temp dir and renamed into place so a crash
+    mid-save (the very event checkpointing exists for) can't destroy the
+    previous restart point. On a multi-host pod this is a COLLECTIVE:
+    every process must call it (the field gathers are all-gathers);
+    process 0 alone writes, to storage all processes can read back
+    (the reference's MPI-IO dump makes the same shared-FS assumption),
+    and a barrier keeps the others from racing past an incomplete
+    save."""
+    payload, meta = _gather_state(sim)
+    if not _is_writer():
+        _sync_processes("save_checkpoint")
+        return
+    tmp = dirpath.rstrip("/") + ".tmp"
+    if os.path.exists(tmp):
+        import shutil
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    np.savez(os.path.join(tmp, "fields.npz"), **payload)
+    shapes = getattr(sim, "shapes", [])
+    with open(os.path.join(tmp, "shapes.pkl"), "wb") as f:
+        pickle.dump(shapes, f)
     with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
     # swap order matters for crash safety: park the old checkpoint aside,
@@ -281,6 +300,13 @@ def save_checkpoint(dirpath: str, sim) -> None:
         shutil.rmtree(old)
     if os.path.exists(dirpath):
         os.replace(dirpath, old)
+    # fault-injection window (faults.crash_point is a no-op unless a
+    # FaultPlan armed crash_in_save): the instant where NEITHER rename
+    # has completed — dirpath absent, dirpath.old complete — which
+    # load_checkpoint's .old fallback must cover (tested in
+    # tests/test_resilience.py::test_crash_mid_save_restores_old)
+    from . import faults
+    faults.crash_point("checkpoint_install")
     os.replace(tmp, dirpath)
     if os.path.exists(old):
         shutil.rmtree(old)
@@ -291,61 +317,93 @@ def load_checkpoint(dirpath: str, sim) -> None:
     """Restore state saved by save_checkpoint into ``sim`` (built with a
     matching config/grid). Falls back to ``dirpath.old`` when a save
     crashed between parking the previous checkpoint and installing the
-    new one."""
-    import jax.numpy as jnp
+    new one — loudly: the fallback means the run lost its newest
+    restart point, which the operator (and the resilience event log)
+    must know about."""
+    import sys
 
     if not os.path.exists(os.path.join(dirpath, "meta.json")):
         old = dirpath.rstrip("/") + ".old"
         if os.path.exists(os.path.join(old, "meta.json")):
+            print(f"cup2d_tpu: checkpoint {dirpath!r} is missing or "
+                  f"incomplete; falling back to parked copy {old!r} "
+                  "(a save crashed between park and install)",
+                  file=sys.stderr)
+            from .resilience import record_event
+            record_event(event="checkpoint_fallback_old",
+                         requested=dirpath, used=old)
             dirpath = old
     with open(os.path.join(dirpath, "meta.json")) as f:
         meta = json.load(f)
+    shapes = None
+    shapes_path = os.path.join(dirpath, "shapes.pkl")
+    if os.path.exists(shapes_path):
+        with open(shapes_path, "rb") as f:
+            shapes = pickle.load(f)
+    with np.load(os.path.join(dirpath, "fields.npz")) as data:
+        _install_state(sim, data, meta, shapes)
+
+
+def _install_state(sim, data, meta: dict, shapes) -> None:
+    """Install a gathered payload (``data``: name -> array mapping, an
+    open npz or a snapshot dict) + meta + shapes into ``sim``. The
+    shared install half of ``load_checkpoint`` and the StepGuard's
+    in-RAM rewind (resilience.py)."""
+    import jax.numpy as jnp
+
     # counters BEFORE the field restore: the _refresh() below branches
     # on step_count (a production-stage restore with the counter still
     # at 0 would eagerly build the ~50 MB two-level coarse maps the
     # lazy-trigger design defers — code-review r5)
     sim.time = float(meta["time"])
     sim.step_count = int(meta["step_count"])
-    with np.load(os.path.join(dirpath, "fields.npz")) as data:
-        if "__forest_keys" in data:
-            f = sim.forest
-            for key in list(f.blocks):
-                f.release(*key)
-            keys = data["__forest_keys"]
-            slots = np.asarray(
-                [f.allocate(int(l), int(i), int(j)) for (l, i, j) in keys],
-                np.int32)
-            for name in f.fields:
-                vals = jnp.asarray(data[name], dtype=f.dtype)
-                f.fields[name] = jnp.zeros(
-                    (f.capacity,) + vals.shape[1:], f.dtype
-                ).at[jnp.asarray(slots)].set(vals)
-            if hasattr(sim, "_ord"):
-                # the restored slot fields are now the truth — discard
-                # the ordered-state cache outright. Leaving _ord_dirty
-                # set would make the next _ordered_state() raise, and
-                # its advice (sync_fields) would overwrite the freshly
-                # restored fields with pre-restore data (ADVICE r3).
-                # The key is re-anchored (not None-ed) at the restored
-                # (version, wver) so a field write BETWEEN restore and
-                # the first step still trips the wver-moved branch that
-                # drops the restored dt cache — _ordered_state()'s
-                # invalidation is guarded by _ord_key being non-None.
-                sim._ord = None
-                sim._ord_dirty = False
-                if hasattr(sim, "_refresh"):
-                    # refresh BEFORE anchoring: an exactly-full forest
-                    # makes the first _refresh_impl call _grow(), whose
-                    # field reassignments move wver — anchoring at the
-                    # pre-refresh wver would then spuriously drop the
-                    # restored dt cache below
-                    sim._refresh()
-                sim._ord_key = (f.version, f.fields.wver)
-        else:
-            sim.state = type(sim.state)(**{
-                k: jnp.asarray(data[k], dtype=sim.grid.dtype)
-                for k in sim.state._fields
-            })
+    if "__forest_keys" in data:
+        f = sim.forest
+        for key in list(f.blocks):
+            f.release(*key)
+        keys = data["__forest_keys"]
+        slots = np.asarray(
+            [f.allocate(int(l), int(i), int(j)) for (l, i, j) in keys],
+            np.int32)
+        for name in f.fields:
+            vals = jnp.asarray(data[name], dtype=f.dtype)
+            f.fields[name] = jnp.zeros(
+                (f.capacity,) + vals.shape[1:], f.dtype
+            ).at[jnp.asarray(slots)].set(vals)
+        if hasattr(sim, "_ord"):
+            # the restored slot fields are now the truth — discard
+            # the ordered-state cache outright. Leaving _ord_dirty
+            # set would make the next _ordered_state() raise, and
+            # its advice (sync_fields) would overwrite the freshly
+            # restored fields with pre-restore data (ADVICE r3).
+            # The key is re-anchored (not None-ed) at the restored
+            # (version, wver) so a field write BETWEEN restore and
+            # the first step still trips the wver-moved branch that
+            # drops the restored dt cache — _ordered_state()'s
+            # invalidation is guarded by _ord_key being non-None.
+            sim._ord = None
+            sim._ord_dirty = False
+            if hasattr(sim, "_refresh"):
+                # refresh BEFORE anchoring: an exactly-full forest
+                # makes the first _refresh_impl call _grow(), whose
+                # field reassignments move wver — anchoring at the
+                # pre-refresh wver would then spuriously drop the
+                # restored dt cache below
+                sim._refresh()
+            sim._ord_key = (f.version, f.fields.wver)
+    else:
+        # jnp.array (copy=True), NOT jnp.asarray: asarray zero-copies a
+        # matching-dtype numpy buffer on the CPU backend, and these
+        # arrays become the state the stepping jits DONATE — a donated
+        # alias of numpy-owned (npz-extracted, not XLA-aligned) memory
+        # intermittently corrupts the heap (pre-PR2 the restart CLI
+        # path crashed ~50% of runs with SIGSEGV/"corrupted
+        # double-linked list"). The forest branch is safe as-is: its
+        # gathered values land in fresh .at[].set outputs.
+        sim.state = type(sim.state)(**{
+            k: jnp.array(data[k], dtype=sim.grid.dtype)
+            for k in sim.state._fields
+        })
     # restore the cached next-dt state (or clear it for checkpoints
     # predating dt_cache): the restart must take the SAME dt branch as
     # the uninterrupted run (see save_checkpoint)
@@ -372,8 +430,43 @@ def load_checkpoint(dirpath: str, sim) -> None:
         sim._coarse_on = bool(trig["coarse_on"])
         sim._last_iters = int(trig["last_iters"])
         sim._last_iters_dev = None
-    shapes_path = os.path.join(dirpath, "shapes.pkl")
-    if hasattr(sim, "shapes") and os.path.exists(shapes_path):
-        with open(shapes_path, "rb") as f:
-            sim.shapes[:] = pickle.load(f)
+    if hasattr(sim, "shapes") and shapes is not None:
+        sim.shapes[:] = shapes
         sim._initialized = True  # fields already hold the blended state
+
+
+# ---------------------------------------------------------------------------
+# in-RAM snapshots (the StepGuard's rewind ring, resilience.py)
+# ---------------------------------------------------------------------------
+
+class Snapshot(NamedTuple):
+    """One good state in host RAM: the checkpoint payload without the
+    disk. ``meta`` is json-round-tripped and ``shapes`` pickled at
+    capture time so a rewind installs EXACTLY what a disk restore of a
+    checkpoint taken at that instant would (same machinery, same
+    serialization semantics), and later in-place shape mutation cannot
+    leak back into the ring."""
+
+    payload: dict           # field name -> numpy array
+    meta: dict
+    shapes_pkl: object      # bytes | None
+
+
+def snapshot_state(sim) -> Snapshot:
+    """Capture ``sim`` into host RAM (COLLECTIVE on pods, exactly like
+    save_checkpoint — every process holds the full ring, so every
+    process can rewind to the same state)."""
+    payload, meta = _gather_state(sim)
+    shapes = getattr(sim, "shapes", None)
+    return Snapshot(
+        payload=payload,
+        meta=json.loads(json.dumps(meta)),
+        shapes_pkl=pickle.dumps(list(shapes)) if shapes is not None
+        else None)
+
+
+def restore_snapshot(sim, snap: Snapshot) -> None:
+    """Install a snapshot back into ``sim`` (the StepGuard rewind)."""
+    shapes = (pickle.loads(snap.shapes_pkl)
+              if snap.shapes_pkl is not None else None)
+    _install_state(sim, snap.payload, snap.meta, shapes)

@@ -57,7 +57,9 @@ deterministic replicated-host machinery above.
 
 from __future__ import annotations
 
-from typing import Optional
+import sys
+import time
+from typing import Callable, Optional
 
 import jax
 
@@ -77,10 +79,43 @@ def _dist_initialized() -> bool:
     return _dist.global_state.client is not None
 
 
+def _connect_with_retry(connect: Callable[[], None],
+                        attempts: int = 5,
+                        backoff: float = 1.0) -> None:
+    """Bounded exponential-backoff retry around the coordinator connect.
+
+    ``jax.distributed.initialize`` makes ONE attempt; on a preemptible
+    pod the coordinator process routinely comes up seconds after the
+    workers (re-scheduled onto a fresh VM), and a single-shot connect
+    kills the whole bring-up for a transient. Retries are bounded
+    (``attempts``, delays backoff * 2^k) and LOGGED — to stderr and the
+    resilience event log — so a flaky fabric is visible, not silent.
+    The final failure propagates: a pod run degrading to independent
+    single-host runs computes wrong answers with no error."""
+    attempts = max(1, int(attempts))
+    for attempt in range(1, attempts + 1):
+        try:
+            return connect()
+        except Exception as e:
+            if attempt >= attempts:
+                raise
+            delay = backoff * (2.0 ** (attempt - 1))
+            print(f"cup2d_tpu: coordinator connect failed (attempt "
+                  f"{attempt}/{attempts}): {e}; retrying in "
+                  f"{delay:.1f}s", file=sys.stderr)
+            from ..resilience import record_event
+            record_event(event="coordinator_retry", attempt=attempt,
+                         max_attempts=attempts, delay_s=delay,
+                         error=str(e))
+            time.sleep(delay)
+
+
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None,
-                     expected_processes: Optional[int] = None) -> int:
+                     expected_processes: Optional[int] = None,
+                     connect_attempts: int = 5,
+                     connect_backoff: float = 1.0) -> int:
     """Bring up the JAX distributed runtime for a multi-host run (the
     reference's MPI_Init moment, main.cpp:6307).
 
@@ -99,6 +134,10 @@ def init_distributed(coordinator_address: Optional[str] = None,
     `-mesh-hosts` flag / slurm's SLURM_NPROCS) and the call aborts
     unless that many processes actually joined. Returns this process's
     index.
+
+    The connect itself retries with bounded exponential backoff
+    (``connect_attempts`` tries, ``connect_backoff`` * 2^k seconds
+    apart, logged) — see :func:`_connect_with_retry`.
     """
     if _dist_initialized():
         rank = jax.process_index()
@@ -112,10 +151,12 @@ def init_distributed(coordinator_address: Optional[str] = None,
                     "pod environment was detected and no coordinator "
                     "was given — refusing to run single-host silently")
             return 0
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id)
+        _connect_with_retry(
+            lambda: jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id),
+            attempts=connect_attempts, backoff=connect_backoff)
         rank = jax.process_index()
     if expected_processes and jax.process_count() != expected_processes:
         raise RuntimeError(

@@ -1,0 +1,405 @@
+"""Supervised stepping: health verdicts, rewind-and-retry, clean preemption.
+
+The reference's ``main()`` dies on the first NaN and loses the run; our
+CLI inherited that (`__main__.py` pre-PR2 aborted with exit 1, missed
+Inf, and left the force log unclosed). Production AMR frameworks treat
+solver-failure handling and checkpoint/restart as first-class
+subsystems (AMReX, arXiv:2009.12009); the atomic-checkpoint half lives
+in ``io.py`` — this module is the supervision half on top of it:
+
+- :func:`health_verdict`: a per-step health check that rides the
+  diagnostics the step ALREADY pulls (the fused isfinite reduction over
+  vel/pres plus the Poisson ``converged``/``stalled`` flags, which the
+  solver has always computed and nothing consumed). On the CLI driver
+  paths the scalars arrive host-side in the step's existing batched
+  pull, so the verdict adds NO device round trips and NO retraces —
+  asserted by ``tests/test_resilience.py``.
+- :class:`StepGuard`: keeps an in-memory ring of the last K good states
+  (the ``save_checkpoint`` payload machinery, host RAM only) and on a
+  bad verdict walks a bounded recovery ladder:
+
+      1. rewind to the last good state, retry at dt/2
+      2. rewind again, retry at dt/2 with the exact Poisson solve
+      3. restore from the on-disk checkpoint and resume
+      4. abort — post-mortem checkpoint + closed force log
+
+  Every rung emits one JSONL event (step, verdict, action) through
+  :class:`EventLog`.
+- :class:`PreemptionGuard`: SIGTERM latches a flag; the driver loop
+  checkpoints at the next step boundary and exits 0 (preemptible-pod
+  semantics: the grace window is spent writing the restart point, not
+  dying mid-collective).
+
+Multi-host note: the verdict scalars are outputs of global reductions
+(replicated by SPMD semantics) and the snapshot gathers are the same
+collectives ``save_checkpoint`` runs, so every process reaches the same
+ladder decision in the same order — the determinism contract of
+``parallel/launch.py`` extends to recovery. Two known pod-scale gaps
+are ROADMAP open items: the SIGTERM latch is per-process (hosts
+preempted at different instants need a cross-process agreement before
+the collective checkpoint), and the per-good-step snapshot gather is a
+real D2H tax through a TPU tunnel (a device-side ring or a
+snapshot-cadence-with-replay is the follow-up).
+
+Known non-recoverable failure classes are listed in ROADMAP.md "Open
+items" (e.g. losing a process mid-collective changes the topology under
+the SPMD program; only a full restart from disk recovers that).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from collections import deque
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# JSONL event log
+# ---------------------------------------------------------------------------
+
+class EventLog:
+    """Append-only JSONL log of resilience events (one object per line,
+    flushed per event so a dying process keeps its tail).
+
+    Multi-host: once the distributed runtime is up, only process 0
+    writes — the recovery decisions are replicated by construction
+    (see the module docstring), so N processes appending the same
+    lines to one shared-FS file would only duplicate and interleave
+    them. Events BEFORE the runtime joins (coordinator connect
+    retries) are written by every process: they are genuinely
+    per-process and the world membership is unknown at that point."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a")
+
+    @staticmethod
+    def _is_writer() -> bool:
+        # same no-probe check as parallel.launch._dist_initialized
+        # (inlined: importing the parallel package here would drag the
+        # whole sharded stack into library users of EventLog): must
+        # not touch the XLA backend — EventLog exists before
+        # init_distributed runs, and a backend probe would make a
+        # later initialize() impossible
+        import jax
+        probe = getattr(jax.distributed, "is_initialized", None)
+        if probe is not None:
+            inited = bool(probe())
+        else:
+            from jax._src import distributed as _dist
+            inited = _dist.global_state.client is not None
+        return (not inited) or jax.process_index() == 0
+
+    def emit(self, **fields) -> None:
+        if not self._is_writer():
+            return
+        fields.setdefault("wall", time.time())
+        self._f.write(json.dumps(fields, sort_keys=True,
+                                 default=float) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+
+_EVENT_LOG: Optional[EventLog] = None
+
+
+def set_event_log(log: Optional[EventLog]) -> None:
+    """Register the process-wide event sink (io.py's checkpoint-fallback
+    warning and launch.py's connect-retry report through it)."""
+    global _EVENT_LOG
+    _EVENT_LOG = log
+
+
+def record_event(**fields) -> None:
+    """Emit into the registered event log; silently dropped when no run
+    log is active (library users without a supervised loop)."""
+    if _EVENT_LOG is not None:
+        _EVENT_LOG.emit(**fields)
+
+
+# ---------------------------------------------------------------------------
+# per-step health verdict
+# ---------------------------------------------------------------------------
+
+class StepVerdict(NamedTuple):
+    ok: bool
+    reason: str           # "ok" | "nonfinite" | "poisson_nonfinite"
+    #                     | "poisson_exhausted" | "poisson_giveup(injected)"
+
+
+_HEALTH_KEYS = ("finite", "umax", "poisson_converged", "poisson_stalled",
+                "poisson_residual")
+
+
+def health_verdict(diag: dict,
+                   residual_ok: Optional[float] = None) -> StepVerdict:
+    """Classify a step's diagnostics dict.
+
+    Policy: a step is BAD when (a) the fused isfinite reduction over
+    vel/pres failed (covers the Inf the old ``umax != umax`` check
+    missed), (b) the Poisson residual itself is nonfinite, or (c) the
+    solve exited neither converged nor stalled — a breakdown give-up
+    past the restart budget, or max_iter exhaustion — with a residual
+    above ``residual_ok``. A ``stalled`` exit is NOT bad: it is the
+    solver's precision floor (exact-mode solves end there by design,
+    see poisson.bicgstab). ``residual_ok`` (the StepGuard passes 100x
+    the case's poisson_tol) keeps a merely budget-capped solve that
+    still sits near its target out of the recovery ladder — the
+    reference ran its whole life with unchecked budget exhaustion;
+    exhaustion with a residual FAR above target is what recovery is
+    for. ``residual_ok=None`` flags every non-converged non-stalled
+    exit (strict mode).
+
+    On the CLI driver paths every value here is already host-side
+    (batched into the step's one existing pull); if any is still a
+    device array (library paths that keep scalars on device, e.g. the
+    obstacle-free AMR step), they are fetched in ONE device_get.
+    """
+    import jax
+
+    vals = {k: diag[k] for k in _HEALTH_KEYS if k in diag}
+    if any(isinstance(v, jax.Array) for v in vals.values()):
+        vals = jax.device_get(vals)
+    finite = vals.get("finite")
+    if finite is None:
+        u = float(vals.get("umax", 0.0))
+        finite = np.isfinite(u)
+    if not bool(finite):
+        return StepVerdict(False, "nonfinite")
+    resid = vals.get("poisson_residual")
+    if resid is not None and not np.isfinite(float(resid)):
+        return StepVerdict(False, "poisson_nonfinite")
+    conv = vals.get("poisson_converged")
+    stall = vals.get("poisson_stalled")
+    if conv is not None and not bool(conv) \
+            and stall is not None and not bool(stall):
+        rf = float(resid) if resid is not None else float("inf")
+        if residual_ok is None or not (rf <= residual_ok):
+            return StepVerdict(False, "poisson_exhausted")
+    return StepVerdict(True, "ok")
+
+
+# ---------------------------------------------------------------------------
+# the supervised stepper
+# ---------------------------------------------------------------------------
+
+class ResilienceAbort(RuntimeError):
+    """The recovery ladder is exhausted; the run cannot continue. A
+    post-mortem checkpoint (if configured) was written before raising."""
+
+
+class StepGuard:
+    """Wraps ``sim.step_once`` with verdict + bounded recovery ladder.
+
+    Parameters
+    ----------
+    sim : Simulation | AMRSim (any driver with step_once/time/step_count)
+    ring : how many good states to keep in host RAM (>= 1). The
+        current ladder consumes only the LATEST entry (rewind-retry
+        targets the failed step); depth > 1 buys nothing yet and
+        multiplies the per-step snapshot RAM, so the default is 1 — a
+        deeper-rewind rung over older entries is a ROADMAP open item.
+    ckpt_dir : the run's on-disk checkpoint (the disk-restore rung;
+        None or missing disables that rung)
+    postmortem_dir : where the abort rung writes its final checkpoint
+    event_log : EventLog for the JSONL recovery events
+    faults : FaultPlan whose pre/post-step hooks this guard drives
+    recover : False = verdict-only mode (first bad verdict aborts, with
+        the same post-mortem/event path — the supervised replacement
+        for the old inline NaN check)
+    """
+
+    def __init__(self, sim, *, ring: int = 1, ckpt_dir: Optional[str] = None,
+                 postmortem_dir: Optional[str] = None,
+                 event_log: Optional[EventLog] = None,
+                 faults=None, recover: bool = True):
+        self.sim = sim
+        self.ring: deque = deque(maxlen=max(1, int(ring)))
+        self.ckpt_dir = ckpt_dir
+        self.postmortem_dir = postmortem_dir
+        self.event_log = event_log
+        self.faults = faults
+        self.recover = recover
+        self.recoveries = 0     # completed recovery actions (telemetry)
+
+    # -- snapshot machinery (io.py payload gather/install, RAM only) --
+    def _snapshot(self):
+        from .io import snapshot_state
+        return snapshot_state(self.sim)
+
+    def _rewind(self) -> None:
+        from .io import restore_snapshot
+        restore_snapshot(self.sim, self.ring[-1])
+
+    def _disk_available(self) -> bool:
+        return bool(self.ckpt_dir) and (
+            os.path.exists(os.path.join(self.ckpt_dir, "meta.json"))
+            or os.path.exists(os.path.join(
+                self.ckpt_dir.rstrip("/") + ".old", "meta.json")))
+
+    # -- one supervised step ------------------------------------------
+    def step(self, dt: Optional[float] = None) -> dict:
+        sim = self.sim
+        if not self.ring:
+            # run the lazy chi-blend initialization BEFORE seeding: a
+            # snapshot of the pre-initialize state marks the sim
+            # initialized on restore (_install_state restores shapes),
+            # so a rewind after a FIRST-step failure would silently
+            # skip the blend and fork the trajectory from t=0
+            if getattr(sim, "shapes", None) \
+                    and not getattr(sim, "_initialized", False):
+                sim.initialize()
+            # seed: the pre-first-step state is by definition good
+            self.ring.append(self._snapshot())
+        rung = 0
+        retry_dt: Optional[float] = dt
+        while True:
+            t0, step0 = sim.time, sim.step_count
+            diag = self._attempt(retry_dt, exact=(rung == 2))
+            v = self._verdict(diag, step0)
+            if v.ok:
+                self.ring.append(self._snapshot())
+                if self.faults is not None:
+                    self.faults.fire_post_step(sim.step_count)
+                return diag
+            dt_used = sim.time - t0
+            action = self._next_action(rung)
+            if action == "abort":
+                self._abort(step0, v, diag, dt_used)
+            self._emit(step=step0, verdict=v.reason, action=action,
+                       dt=dt_used, rung=rung)
+            self.recoveries += 1
+            if action in ("retry", "escalate"):
+                self._rewind()
+                if action == "retry":
+                    # half the failed dt; a nonfinite dt (fault at a
+                    # cold-cache step) falls back to a fresh CFL dt
+                    # from the restored clean state
+                    retry_dt = (0.5 * dt_used
+                                if np.isfinite(dt_used) and dt_used > 0
+                                else None)
+            else:  # disk_restore: rewind possibly many steps
+                from .io import load_checkpoint
+                load_checkpoint(self.ckpt_dir, sim)
+                self.ring.clear()
+                self.ring.append(self._snapshot())
+                retry_dt = None
+            rung += 1
+
+    def _attempt(self, dt, exact: bool = False) -> dict:
+        sim = self.sim
+        if self.faults is not None:
+            self.faults.apply_pre_step(sim)
+        if exact:
+            sim._force_exact = True
+        try:
+            return sim.step_once(dt=dt)
+        finally:
+            if exact:
+                sim._force_exact = False
+
+    def _verdict(self, diag: dict, step: int) -> StepVerdict:
+        tol = float(getattr(self.sim.cfg, "poisson_tol", 0.0))
+        v = health_verdict(diag,
+                           residual_ok=(100.0 * tol if tol > 0 else None))
+        if v.ok and self.faults is not None \
+                and self.faults.poisson_giveup_at(step):
+            v = StepVerdict(False, "poisson_giveup(injected)")
+        return v
+
+    def _next_action(self, rung: int) -> str:
+        if not self.recover:
+            return "abort"
+        if rung == 0:
+            return "retry"
+        if rung == 1:
+            return "escalate"
+        if rung == 2 and self._disk_available():
+            return "disk_restore"
+        return "abort"
+
+    def _emit(self, **fields) -> None:
+        if self.event_log is not None:
+            self.event_log.emit(event="recovery",
+                                sim_time=float(self.sim.time), **fields)
+
+    def _abort(self, step: int, v: StepVerdict, diag: dict,
+               dt_used: float) -> None:
+        """The last rung: post-mortem checkpoint + diagnostic dump of
+        the dead state, force log closed, one final event — then raise.
+        A dead run must always leave enough on disk to be diagnosed and
+        (where the fault was environmental) resumed."""
+        sim = self.sim
+        pm = None
+        if self.postmortem_dir:
+            try:
+                from .io import save_checkpoint
+                save_checkpoint(self.postmortem_dir, sim)
+                pm = self.postmortem_dir
+            except Exception as e:   # the abort must not be masked
+                print(f"cup2d_tpu: post-mortem checkpoint failed: {e}",
+                      file=sys.stderr)
+        flog = getattr(sim, "force_log", None)
+        if flog is not None and not flog.closed:
+            flog.close()
+        summary = {k: _as_float(diag[k])
+                   for k in ("umax", "poisson_residual", "poisson_iters")
+                   if k in diag}
+        self._emit(step=step, verdict=v.reason, action="abort",
+                   dt=dt_used, postmortem=pm, diag=summary)
+        raise ResilienceAbort(
+            f"step {step}: {v.reason}; recovery ladder exhausted"
+            + (f" (post-mortem checkpoint: {pm})" if pm else ""))
+
+
+def _as_float(x) -> float:
+    try:
+        return float(np.asarray(x))
+    except Exception:
+        return float("nan")
+
+
+# ---------------------------------------------------------------------------
+# preemption-safe shutdown
+# ---------------------------------------------------------------------------
+
+class PreemptionGuard:
+    """Latches SIGTERM (and optionally other signals) into a flag the
+    driver loop polls at step boundaries. Installing mid-collective-safe
+    shutdown any other way is not possible: the handler must not touch
+    device state, so it only sets the flag."""
+
+    def __init__(self):
+        self.triggered = False
+        self.signum: Optional[int] = None
+        self._prev: dict = {}
+
+    def install(self, signums=None) -> "PreemptionGuard":
+        import signal
+        if signums is None:
+            signums = (signal.SIGTERM,)
+
+        def _handler(signum, frame):
+            self.triggered = True
+            self.signum = signum
+
+        for s in signums:
+            self._prev[s] = signal.signal(s, _handler)
+        return self
+
+    def uninstall(self) -> None:
+        import signal
+        for s, h in self._prev.items():
+            signal.signal(s, h)
+        self._prev.clear()

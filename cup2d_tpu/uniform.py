@@ -255,12 +255,21 @@ class UniformGrid:
         dv = pressure_gradient_update_fused(pres, h, dt, self.spmd_safe)
         return vel + dv * ih2, pres, res
 
-    def step_diag(self, vel, res) -> dict:
+    def step_diag(self, vel, pres, res) -> dict:
         umax = jnp.max(jnp.abs(vel))
         return {
             "poisson_iters": res.iters,
             "poisson_residual": res.residual,
             "poisson_stalled": res.stalled,
+            # the solver has always computed `converged`; surfacing it
+            # here lets the resilience verdict consume it for free
+            # (resilience.health_verdict — PR 2)
+            "poisson_converged": res.converged,
+            # fused isfinite reduction over vel AND pres: the health
+            # verdict's cheap NaN/Inf detector, riding the same device
+            # call (umax alone misses a NaN confined to the pressure)
+            "finite": jnp.all(jnp.isfinite(vel))
+            & jnp.all(jnp.isfinite(pres)),
             "umax": umax,
             # next step's dt rides the same device call (no separate
             # dt round trip, r1 weak #10)
@@ -291,7 +300,8 @@ class UniformGrid:
             vel, state.pres,
             state.chi if obstacle_terms else None,
             state.udef if obstacle_terms else None, dt, exact_poisson)
-        return state._replace(vel=vel, pres=pres), self.step_diag(vel, res)
+        return state._replace(vel=vel, pres=pres), \
+            self.step_diag(vel, pres, res)
 
     def vorticity_field(self, vel: jnp.ndarray) -> jnp.ndarray:
         return vorticity(pad_vector(vel, 1), 1, self.h)

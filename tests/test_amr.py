@@ -536,3 +536,21 @@ def test_twolevel_env_gate_rejects_typos(monkeypatch):
                     extent=1.0, dtype="float64")
     with _pytest.raises(ValueError, match="CUP2D_TWOLEVEL"):
         AMRSim(cfg, shapes=[])
+
+
+def test_two_level_ladder_bounded_by_active_levels():
+    """The two-level preconditioner's per-level image ladder must stop
+    at the finest ACTIVE level (ADVICE r5 / PR 2): a levelMax-6 forest
+    sitting entirely at level 1 must not carry level-5 full-domain
+    image entries (O(4^level) cells) through _deposit/_interp. The
+    remaining full-domain-per-NON-empty-level cost is a documented
+    scaling cliff (amr._pressure_project)."""
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=6, level_start=1,
+                    extent=1.0, dtype="float64")
+    sim = AMRSim(cfg, shapes=[])
+    sim._refresh()
+    cw = sim._use_coarse(True)
+    active = {int(v) for v in np.unique(sim.forest.level[sim._order])}
+    assert set(cw["lev"].keys()) == active == {1}
+    # and the exact solve actually runs through the bounded ladder
+    sim.step_once(dt=1e-3)

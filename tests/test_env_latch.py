@@ -1,0 +1,123 @@
+"""Static guard for the env-latching convention (ADVICE r5 / PR 1).
+
+Every CUP2D_* environment gate must be LATCHED — read exactly once at a
+sanctioned construction/enable point and stored — never consulted
+mid-run: a read inside a jitted body or a per-refresh helper means a
+mid-run env mutation silently flips an operator/preconditioner form at
+the next retrace or regrid (the hazard class CUP2D_SHARD_EXCHANGE and
+CUP2D_POIS/CUP2D_TWOLEVEL were each fixed for). This test walks the
+package AST and fails on any CUP2D_* read outside the sanctioned latch
+sites below — adding a new gate means adding a new latch site HERE, on
+purpose, with a reason.
+"""
+
+import ast
+import os
+
+PKG = os.path.normpath(
+    os.path.join(os.path.dirname(__file__), "..", "cup2d_tpu"))
+
+# files where ANY CUP2D_* read is a sanctioned latch:
+#   config.py — the typed-config construction point
+#   faults.py — FaultPlan.from_env, the fault-injection latch
+SANCTIONED_FILES = {"config.py", "faults.py"}
+
+# (file, enclosing scope) -> allowed vars. Each is a construct-once /
+# enable-once latch, grandfathered with its reason:
+SANCTIONED_SITES = {
+    # A/B gates latched per-sim in the constructor (ADVICE r5)
+    ("amr.py", "AMRSim.__init__"): {"CUP2D_POIS", "CUP2D_TWOLEVEL"},
+    # per-grid constructor latch (stored as self.use_pallas)
+    ("uniform.py", "UniformGrid.__init__"): {"CUP2D_PALLAS"},
+    # read once from ShardedAMRSim.__init__, stored as self._exchange
+    ("parallel/forest_mesh.py", "_exchange_mode"):
+        {"CUP2D_SHARD_EXCHANGE"},
+    # enable-once process knobs (cache paths, not numerics gates)
+    ("cache.py", "enable_compilation_cache"): {"CUP2D_CACHE"},
+    ("native/__init__.py", "_load"): {"CUP2D_NATIVE_CACHE"},
+}
+
+
+def _env_var_of(node):
+    """Return the env var name a node reads, or None. Catches
+    os.environ[...] / os.environ.get|pop|setdefault(...) / os.getenv(...)
+    (and the bare `environ`/`getenv` import-form spellings)."""
+    def is_environ(n):
+        return (isinstance(n, ast.Attribute) and n.attr == "environ") \
+            or (isinstance(n, ast.Name) and n.id == "environ")
+
+    def const(n):
+        return n.value if (isinstance(n, ast.Constant)
+                           and isinstance(n.value, str)) else "<dynamic>"
+
+    if isinstance(node, ast.Subscript) and is_environ(node.value):
+        return const(node.slice)
+    if isinstance(node, ast.Call):
+        f = node.func
+        envget = (isinstance(f, ast.Attribute)
+                  and f.attr in ("get", "pop", "setdefault")
+                  and is_environ(f.value))
+        getenv = ((isinstance(f, ast.Attribute) and f.attr == "getenv")
+                  or (isinstance(f, ast.Name) and f.id == "getenv"))
+        if envget or getenv:
+            return const(node.args[0]) if node.args else "<dynamic>"
+    return None
+
+
+def _cup2d_env_reads(path):
+    """(scope, var, lineno) for every constant CUP2D_* env read."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    out = []
+
+    def visit(node, scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            scope = scope + [node.name]
+        var = _env_var_of(node)
+        if var is not None and var.startswith("CUP2D_"):
+            out.append((".".join(scope) or "<module>", var, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child, scope)
+
+    visit(tree, [])
+    return out
+
+
+def test_cup2d_env_reads_only_at_latch_points():
+    violations = []
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, PKG).replace(os.sep, "/")
+            if rel in SANCTIONED_FILES:
+                continue
+            allowed_by_scope = {scope: vars_
+                                for (f, scope), vars_
+                                in SANCTIONED_SITES.items() if f == rel}
+            for scope, var, line in _cup2d_env_reads(full):
+                if var in allowed_by_scope.get(scope, ()):
+                    continue
+                violations.append(
+                    f"cup2d_tpu/{rel}:{line} reads {var} in {scope}")
+    assert not violations, (
+        "CUP2D_* env vars must be read ONCE at a sanctioned latch point "
+        "(config.py / AMRSim.__init__ / faults.py / the grandfathered "
+        "sites in tests/test_env_latch.py), never mid-run:\n  "
+        + "\n  ".join(violations))
+
+
+def test_latch_allowlist_matches_reality():
+    """The sanctioned-site table must not rot: every grandfathered
+    (file, scope, var) entry still exists — a refactor that moves a
+    latch must move its allowlist row too, keeping the table an
+    accurate map of where gates live."""
+    for (rel, scope), vars_ in SANCTIONED_SITES.items():
+        reads = _cup2d_env_reads(os.path.join(PKG, rel))
+        found = {v for s, v, _ in reads if s == scope}
+        assert vars_ <= found, (
+            f"cup2d_tpu/{rel} scope {scope}: expected latched reads of "
+            f"{sorted(vars_)}, found {sorted(found)}")

@@ -1,0 +1,510 @@
+"""Supervised run loop tests (resilience.py + faults.py): every rung of
+the recovery ladder exercised by fault injection, the crash-mid-save
+window, SIGTERM preemption through the CLI, coordinator connect
+backoff, and the zero-overhead contract of the health verdict (an
+unfaulted guarded run is bit-identical and adds no device pulls or
+retraces)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from cup2d_tpu import faults as faults_mod
+from cup2d_tpu.config import SimConfig
+from cup2d_tpu.faults import FaultPlan, InjectedCrash
+from cup2d_tpu.io import load_checkpoint, save_checkpoint
+from cup2d_tpu.models import DiskShape
+from cup2d_tpu.resilience import (EventLog, ResilienceAbort, StepGuard,
+                                  health_verdict, set_event_log)
+from cup2d_tpu.sim import Simulation
+
+
+def _cfg(**kw):
+    base = dict(bpdx=1, bpdy=1, level_max=1, level_start=0, extent=1.0,
+                nu=1e-3, cfl=0.4, lam=1e6, dtype="float64",
+                max_poisson_iterations=100)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _sim():
+    disk = DiskShape(0.1, 0.4, 0.5, prescribed=(0.2, 0.0))
+    return Simulation(_cfg(), shapes=[disk], level=3)
+
+
+def _amr_cfg():
+    return SimConfig(bpdx=1, bpdy=1, level_max=3, level_start=1,
+                     extent=1.0, dtype="float64", nu=1e-3, lam=1e5,
+                     rtol=0.5, ctol=0.05, max_poisson_iterations=40,
+                     poisson_tol=1e-4, poisson_tol_rel=1e-3)
+
+
+def _amr_sim():
+    from cup2d_tpu.amr import AMRSim
+    sim = AMRSim(_amr_cfg(), shapes=[DiskShape(0.08, 0.4, 0.5,
+                                               prescribed=(0.2, 0.0))])
+    sim.compute_forces_every = 0
+    return sim
+
+
+def _recoveries(path):
+    with open(path) as f:
+        evs = [json.loads(line) for line in f if line.strip()]
+    return [e for e in evs if e.get("event") == "recovery"]
+
+
+def _guard(sim, tmp_path, plan=None, **kw):
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    return StepGuard(sim, event_log=log, faults=plan, **kw), \
+        str(tmp_path / "events.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# verdict policy (pure unit)
+# ---------------------------------------------------------------------------
+
+def test_health_verdict_policy():
+    ok = dict(finite=True, umax=1.0, poisson_converged=True,
+              poisson_stalled=False, poisson_residual=1e-5)
+    assert health_verdict(ok).ok
+    # Inf/NaN anywhere in vel/pres -> nonfinite (the old inline driver
+    # check umax != umax missed Inf)
+    assert health_verdict({**ok, "finite": False}).reason == "nonfinite"
+    # no finite flag at all: fall back to umax self-check
+    assert health_verdict({"umax": float("inf")}).reason == "nonfinite"
+    assert health_verdict({"umax": float("nan")}).reason == "nonfinite"
+    assert health_verdict({"umax": 1.0}).ok
+    # nonfinite residual is a solver failure even with finite fields
+    bad_res = {**ok, "poisson_converged": False,
+               "poisson_residual": float("nan")}
+    assert health_verdict(bad_res).reason == "poisson_nonfinite"
+    # neither converged nor stalled = give-up / exhaustion
+    exh = {**ok, "poisson_converged": False, "poisson_stalled": False,
+           "poisson_residual": 10.0}
+    assert health_verdict(exh).reason == "poisson_exhausted"
+    # ... unless the residual already sits near target (budget-capped
+    # solve, reference-parity behavior)
+    assert health_verdict({**exh, "poisson_residual": 1e-5},
+                          residual_ok=1e-3).ok
+    # a stalled exit is the precision floor, not a failure
+    assert health_verdict({**ok, "poisson_converged": False,
+                           "poisson_stalled": True}).ok
+
+
+def test_fault_plan_parse():
+    p = FaultPlan("nan_vel@3, poisson_giveup@5*2, sigterm@7,"
+                  "crash_in_save")
+    assert p.vel_poison[3][1] == 1
+    assert np.isnan(p.vel_poison[3][0])
+    assert p.giveup[5] == 2
+    assert 7 in p.sigterm_steps
+    assert p.crash_points["checkpoint_install"] == 1
+    assert bool(p) and not bool(FaultPlan(""))
+    assert p.poisson_giveup_at(5) and p.poisson_giveup_at(5)
+    assert not p.poisson_giveup_at(5)      # count exhausted
+    with pytest.raises(ValueError):
+        FaultPlan("tyop_fault@3")          # typos must not silently arm
+    with pytest.raises(ValueError):
+        FaultPlan("nan_vel")               # step is required
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead contract: bit-identical, no extra pulls, no retraces
+# ---------------------------------------------------------------------------
+
+def test_guard_unfaulted_bit_identical_uniform(tmp_path, monkeypatch):
+    traces = {"n": 0}
+    orig_impl = Simulation._flow_step_impl
+
+    def counted_impl(self, *a, **k):
+        traces["n"] += 1
+        return orig_impl(self, *a, **k)
+
+    monkeypatch.setattr(Simulation, "_flow_step_impl", counted_impl)
+
+    def run(guarded):
+        sim = _sim()
+        guard = StepGuard(sim) if guarded else None
+        pulls = {"n": 0}
+        real_get = jax.device_get
+
+        def counting_get(x):
+            pulls["n"] += 1
+            return real_get(x)
+
+        t0 = traces["n"]
+        with monkeypatch.context() as m:
+            m.setattr(jax, "device_get", counting_get)
+            for _ in range(5):
+                guard.step() if guarded else sim.step_once()
+        return (np.asarray(sim.state.vel), np.asarray(sim.state.pres),
+                sim.time, pulls["n"], traces["n"] - t0)
+
+    va, pa, ta, pulls_a, traces_a = run(False)
+    vb, pb, tb, pulls_b, traces_b = run(True)
+    assert np.array_equal(va, vb)
+    assert np.array_equal(pa, pb)
+    assert ta == tb
+    # the verdict rides the step's existing batched pull: no extra
+    # device_get, no extra trace of the step function
+    assert pulls_b == pulls_a
+    assert traces_b == traces_a
+
+
+def test_guard_unfaulted_bit_identical_amr(tmp_path, monkeypatch):
+    from cup2d_tpu.amr import AMRSim
+
+    traces = {"n": 0}
+    orig_impl = AMRSim._megastep_impl
+
+    def counted_impl(self, *a, **k):
+        traces["n"] += 1
+        return orig_impl(self, *a, **k)
+
+    monkeypatch.setattr(AMRSim, "_megastep_impl", counted_impl)
+
+    def run(guarded):
+        sim = _amr_sim()
+        sim.initialize()
+        guard = StepGuard(sim) if guarded else None
+        pulls = {"n": 0}
+        real_get = jax.device_get
+
+        def counting_get(x):
+            pulls["n"] += 1
+            return real_get(x)
+
+        t0 = traces["n"]
+        with monkeypatch.context() as m:
+            m.setattr(jax, "device_get", counting_get)
+            for _ in range(3):
+                guard.step() if guarded else sim.step_once()
+        vel = np.asarray(sim.fields()["vel"][sim.forest.order()])
+        return vel, sim.time, pulls["n"], traces["n"] - t0
+
+    va, ta, pulls_a, traces_a = run(False)
+    vb, tb, pulls_b, traces_b = run(True)
+    assert np.array_equal(va, vb)
+    assert ta == tb
+    assert pulls_b == pulls_a
+    assert traces_b == traces_a
+
+
+# ---------------------------------------------------------------------------
+# the recovery ladder, rung by rung
+# ---------------------------------------------------------------------------
+
+def _drive_to(sim, tend, stepper):
+    """Advance to EXACTLY tend (last dt clipped) so faulted and
+    unfaulted runs are compared at the same physical time — a dt/2
+    recovery step otherwise offsets the whole time grid."""
+    while sim.time < tend:
+        if sim._next_dt is not None:
+            dt = min(sim._next_dt, sim._kinematic_dt_cap())
+        else:
+            dt = min(float(sim._dt(sim.state.vel)),
+                     sim._kinematic_dt_cap())
+        stepper(min(dt, tend - sim.time + 1e-15))
+
+
+@pytest.mark.parametrize("directive", ["nan_vel@3", "inf_vel@3"])
+def test_rung1_poison_recovers_via_rewind(tmp_path, directive):
+    tend = 0.3
+    ref = _sim()
+    _drive_to(ref, tend, lambda dt: ref.step_once(dt=dt))
+
+    sim = _sim()
+    guard, evpath = _guard(sim, tmp_path, plan=FaultPlan(directive),
+                           ckpt_dir=None)
+    _drive_to(sim, tend, lambda dt: guard.step(dt=dt))
+
+    evs = _recoveries(evpath)
+    assert [e["action"] for e in evs] == ["retry"]
+    assert evs[0]["step"] == 3
+    assert evs[0]["verdict"] == "nonfinite"
+    vel = np.asarray(sim.state.vel)
+    assert np.all(np.isfinite(vel))
+    assert abs(sim.time - ref.time) < 1e-12
+    # recovered trajectory lands inside the golden-trajectory-style
+    # tolerances of the unfaulted run (test_golden pins umax at rtol
+    # 1e-3 mid-trajectory; measured here: ~7e-4). The full field keeps
+    # a coarse bound only — the Brinkman-penalized body interior is
+    # genuinely dt-sensitive (alpha = 1/(1+lam dt)), so one dt/2 step
+    # legitimately perturbs it at the percent level while the flow
+    # outside stays aligned.
+    ref_v = np.asarray(ref.state.vel)
+    assert abs(np.abs(vel).max() - np.abs(ref_v).max()) \
+        <= 2e-3 * np.abs(ref_v).max()
+    rel = np.linalg.norm(vel - ref_v) / max(np.linalg.norm(ref_v), 1e-30)
+    assert rel < 0.05, rel
+
+
+def test_rung2_escalates_to_exact_poisson(tmp_path):
+    sim = _sim()
+    # two consecutive forced give-ups at step 2: the rewind-retry rung
+    # fails once, the exact-Poisson escalation clears it
+    guard, evpath = _guard(sim, tmp_path,
+                           plan=FaultPlan("poisson_giveup@2*2"))
+    for _ in range(5):
+        guard.step()
+    evs = _recoveries(evpath)
+    assert [e["action"] for e in evs] == ["retry", "escalate"]
+    assert all(e["step"] == 2 for e in evs)
+    assert all(e["verdict"] == "poisson_giveup(injected)" for e in evs)
+    assert sim.step_count == 5
+    assert not sim._force_exact        # restored after the escalation
+
+
+def test_rung3_disk_restore_replays_bit_exactly(tmp_path):
+    tend = 0.3
+    ref = _sim()
+    while ref.time < tend:
+        ref.step_once()
+
+    ck = str(tmp_path / "ck")
+    sim = _sim()
+    guard, evpath = _guard(sim, tmp_path,
+                           plan=FaultPlan("poisson_giveup@4*3"),
+                           ckpt_dir=ck)
+    while sim.time < tend:
+        guard.step()
+        if sim.step_count == 2:
+            save_checkpoint(ck, sim)
+    evs = _recoveries(evpath)
+    assert [e["action"] for e in evs] == \
+        ["retry", "escalate", "disk_restore"]
+    # after the disk restore the run replays steps 2..4 on the normal
+    # path (the give-up budget is spent) — the bit-exact resume
+    # contract makes the final state EQUAL to the unfaulted run
+    assert np.allclose(np.asarray(sim.state.vel),
+                       np.asarray(ref.state.vel), atol=1e-12)
+    assert abs(sim.time - ref.time) < 1e-12
+
+
+def test_rung4_abort_leaves_postmortem(tmp_path):
+    sim = _sim()
+    sim.force_log = open(tmp_path / "forces.csv", "w")
+    pm = str(tmp_path / "postmortem")
+    # re-poisoned on every attempt: nothing recovers, no disk rung
+    guard, evpath = _guard(sim, tmp_path, plan=FaultPlan("nan_vel@1*4"),
+                           postmortem_dir=pm)
+    guard.step()
+    with pytest.raises(ResilienceAbort):
+        guard.step()
+    evs = _recoveries(evpath)
+    assert [e["action"] for e in evs] == ["retry", "escalate", "abort"]
+    assert evs[-1]["postmortem"] == pm
+    # the dead run left a loadable post-mortem checkpoint and a closed
+    # force log (the old __main__ NaN abort leaked both)
+    assert sim.force_log.closed
+    fresh = _sim()
+    load_checkpoint(pm, fresh)
+    assert fresh.step_count == sim.step_count
+
+
+def test_first_step_failure_keeps_chi_blend(tmp_path):
+    """The ring seed must be captured AFTER the lazy chi-blend
+    initialization: restoring a pre-initialize snapshot marks the sim
+    initialized (shapes restore), so a rewind after a FIRST-step
+    failure would silently skip the blend — for a deforming fish the
+    recovered trajectory forks from t=0 (code-review PR 2)."""
+    from cup2d_tpu.models import FishShape
+
+    def mk():
+        cfg = _cfg()
+        return Simulation(cfg, shapes=[FishShape(0.2, 0.5, 0.5, 0.0,
+                                                 cfg.min_h)], level=3)
+
+    sim = mk()
+    guard, evpath = _guard(sim, tmp_path,
+                           plan=FaultPlan("poisson_giveup@0"))
+    guard.step()
+    evs = _recoveries(evpath)
+    assert [e["action"] for e in evs] == ["retry"] and evs[0]["step"] == 0
+    # a fresh run, initialized then stepped once at the SAME (halved)
+    # dt, must match the recovered state bit-for-bit
+    ref = mk()
+    ref.step_once(dt=sim.time)
+    assert np.allclose(np.asarray(sim.state.vel),
+                       np.asarray(ref.state.vel), atol=1e-14)
+
+
+def test_verdict_only_mode_aborts_first_failure(tmp_path):
+    sim = _sim()
+    pm = str(tmp_path / "postmortem")
+    guard, evpath = _guard(sim, tmp_path, plan=FaultPlan("nan_vel@1"),
+                           postmortem_dir=pm, recover=False)
+    guard.step()
+    with pytest.raises(ResilienceAbort):
+        guard.step()
+    evs = _recoveries(evpath)
+    assert [e["action"] for e in evs] == ["abort"]
+    assert os.path.exists(os.path.join(pm, "meta.json"))
+
+
+# ---------------------------------------------------------------------------
+# crash-mid-save window + .old fallback (io.py satellites)
+# ---------------------------------------------------------------------------
+
+def test_crash_mid_save_restores_old_bitexact(tmp_path, capsys):
+    ck = str(tmp_path / "ck")
+    sim = _sim()
+    sim.step_once()
+    sim.step_once()
+    save_checkpoint(ck, sim)                     # v1, the survivor
+    with np.load(os.path.join(ck, "fields.npz")) as d:
+        v1 = {k: np.array(d[k]) for k in d.files}
+    sim.step_once()
+    faults_mod.install(FaultPlan("crash_in_save"))
+    try:
+        with pytest.raises(InjectedCrash):
+            save_checkpoint(ck, sim)             # dies park->install
+    finally:
+        faults_mod.install(None)
+    # the crash window: dirpath gone, the parked .old is complete
+    assert not os.path.exists(os.path.join(ck, "meta.json"))
+    assert os.path.exists(os.path.join(ck + ".old", "meta.json"))
+
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    set_event_log(log)
+    try:
+        fresh = _sim()
+        load_checkpoint(ck, fresh)
+    finally:
+        set_event_log(None)
+        log.close()
+    # loud fallback: stderr warning + resilience event
+    assert "falling back" in capsys.readouterr().err
+    with open(tmp_path / "events.jsonl") as f:
+        evs = [json.loads(line) for line in f]
+    assert any(e.get("event") == "checkpoint_fallback_old" for e in evs)
+    # ... and the restored state is the parked copy, bit-exactly
+    assert fresh.step_count == 2
+    restored = {k: np.asarray(v)
+                for k, v in fresh.state._asdict().items()}
+    for k, v in v1.items():
+        assert np.array_equal(restored[k], v), k
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM preemption through the CLI (+ restart from its checkpoint)
+# ---------------------------------------------------------------------------
+
+def _cli_cmd(outdir, extra):
+    return [
+        sys.executable, "-m", "cup2d_tpu",
+        "-bpdx", "1", "-bpdy", "1", "-levelMax", "1", "-levelStart", "0",
+        "-Rtol", "2", "-Ctol", "1", "-extent", "1", "-CFL", "0.4",
+        "-tend", "1", "-lambda", "1e6", "-nu", "0.001",
+        "-poissonTol", "1e-3", "-poissonTolRel", "1e-2",
+        "-maxPoissonRestarts", "0", "-maxPoissonIterations", "100",
+        "-AdaptSteps", "20", "-tdump", "0", "-level", "3",
+        "-dtype", "float64",
+        "-shapes", "angle=0 L=0.25 xpos=0.5 ypos=0.5",
+        "-output", str(outdir),
+    ] + extra
+
+
+def _run_cli(outdir, extra, fault=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    env.pop("CUP2D_FAULTS", None)
+    if fault:
+        env["CUP2D_FAULTS"] = fault
+    return subprocess.run(_cli_cmd(outdir, extra), cwd="/root/repo",
+                          env=env, timeout=400, capture_output=True,
+                          text=True)
+
+
+def test_sigterm_checkpoints_and_restart_resumes(tmp_path):
+    out1 = tmp_path / "run1"
+    out2 = tmp_path / "run2"
+    out3 = tmp_path / "run3"
+
+    # preempted run: SIGTERM after step 3 -> clean exit 0 + checkpoint
+    r1 = _run_cli(out1, ["-maxSteps", "8"], fault="sigterm@3")
+    assert r1.returncode == 0, r1.stderr[-2000:]
+    assert "SIGTERM" in r1.stderr
+    assert os.path.exists(out1 / "checkpoint" / "meta.json")
+    with open(out1 / "events.jsonl") as f:
+        evs = [json.loads(line) for line in f]
+    sig = [e for e in evs if e.get("event") == "sigterm_checkpoint"]
+    assert len(sig) == 1 and sig[0]["step"] == 3
+
+    # resumed run continues to step 6 and checkpoints there
+    r2 = _run_cli(out2, ["-maxSteps", "6", "-checkpointEvery", "6",
+                         "-restart", str(out1 / "checkpoint")])
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    # uninterrupted twin of the same case
+    r3 = _run_cli(out3, ["-maxSteps", "6", "-checkpointEvery", "6"])
+    assert r3.returncode == 0, r3.stderr[-2000:]
+
+    with open(out2 / "checkpoint" / "meta.json") as f:
+        m2 = json.load(f)
+    with open(out3 / "checkpoint" / "meta.json") as f:
+        m3 = json.load(f)
+    assert m2["step_count"] == m3["step_count"] == 6
+    assert m2["time"] == m3["time"]
+    with np.load(out2 / "checkpoint" / "fields.npz") as a, \
+            np.load(out3 / "checkpoint" / "fields.npz") as b:
+        assert set(a.files) == set(b.files)
+        for k in a.files:
+            assert np.array_equal(a[k], b[k]), k
+
+
+def test_cli_nan_abort_via_guard(tmp_path):
+    """The old __main__ NaN check (missed Inf, leaked the force log,
+    left no state behind) is routed through the guard's abort rung: a
+    persistent Inf with supervision disabled exits 1 AND leaves a
+    post-mortem checkpoint + abort event."""
+    out = tmp_path / "run"
+    r = _run_cli(out, ["-maxSteps", "6", "-noSupervise"],
+                 fault="inf_vel@2")
+    assert r.returncode == 1, r.stderr[-2000:]
+    assert "unrecoverable" in r.stderr
+    assert os.path.exists(out / "postmortem" / "meta.json")
+    with open(out / "events.jsonl") as f:
+        evs = [json.loads(line) for line in f]
+    aborts = [e for e in evs if e.get("event") == "recovery"
+              and e.get("action") == "abort"]
+    assert len(aborts) == 1 and aborts[0]["verdict"] == "nonfinite"
+
+
+# ---------------------------------------------------------------------------
+# coordinator connect backoff (launch.py)
+# ---------------------------------------------------------------------------
+
+def test_connect_backoff_bounded_and_logged(tmp_path):
+    from cup2d_tpu.parallel.launch import _connect_with_retry
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("connection refused")
+
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    set_event_log(log)
+    try:
+        _connect_with_retry(flaky, attempts=5, backoff=0.001)
+    finally:
+        set_event_log(None)
+        log.close()
+    assert calls["n"] == 3
+    with open(tmp_path / "events.jsonl") as f:
+        evs = [json.loads(line) for line in f]
+    retries = [e for e in evs if e.get("event") == "coordinator_retry"]
+    assert [e["attempt"] for e in retries] == [1, 2]
+
+    def dead():
+        raise RuntimeError("unreachable")
+
+    with pytest.raises(RuntimeError, match="unreachable"):
+        _connect_with_retry(dead, attempts=3, backoff=0.0)
