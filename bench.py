@@ -131,7 +131,7 @@ def run_size(size: int, n_warmup: int, n_steps: int):
         t0 = time.perf_counter()
         for _ in range(n_steps):
             state, diag = step(state, dt)
-            diags.append(diag["poisson_iters"])
+            diags.append(diag)
         _fence(state.vel)
         t1 = time.perf_counter()
         if (t1 - t0) >= 5.0 * lat or n_steps >= 640:
@@ -139,7 +139,11 @@ def run_size(size: int, n_warmup: int, n_steps: int):
             break
         n_steps *= 4
     wall = max(t1 - t0 - lat, 1e-9)
-    iters = [int(d) for d in diags]
+    # ONE batched pull of every step's whole diag dict, outside the
+    # timed window (the per-scalar int() pulls this replaces cost one
+    # round trip each)
+    diags = jax.device_get(diags)
+    iters = [int(d["poisson_iters"]) for d in diags]
     iters_total = sum(iters)
 
     # advection stage alone (the non-Poisson bulk of the step); extra
@@ -179,6 +183,23 @@ def run_size(size: int, n_warmup: int, n_steps: int):
     psolve_iters = int(res.iters)
     poisson_ms_per_iter = psolve_wall / max(psolve_iters, 1) * 1e3
 
+    # the timed steps as run-telemetry records in the SAME schema a
+    # production run streams to metrics.jsonl (profiling.METRICS_KEYS)
+    # — BENCH_*.json and run telemetry are one trajectory. t/step are
+    # synthetic (the bench holds dt fixed and restarts from warmup);
+    # wall_ms is the per-step mean of the fenced window.
+    from cup2d_tpu.profiling import MetricsRecorder, summarize_metrics
+    rec = MetricsRecorder(sink=None)
+    step_ms_mean = wall / n_steps * 1e3
+    records = [
+        rec.record_step(step=i + 1, t=float(dt) * (i + 1),
+                        dt=float(dt), diag=d, wall_ms=step_ms_mean)
+        for i, d in enumerate(diags)]
+    telemetry = {
+        "summary": summarize_metrics(records),
+        "last_records": records[-8:],
+    }
+
     cells = grid.nx * grid.ny
     cells_steps_per_sec = cells * n_steps / wall
     iters_per_step = iters_total / n_steps
@@ -187,6 +208,7 @@ def run_size(size: int, n_warmup: int, n_steps: int):
     bytes_ = cells * (BYTES_STEP_PER_CELL * n_steps
                       + BYTES_ITER_PER_CELL * iters_total)
     return {
+        "telemetry": telemetry,
         "grid": f"{size}x{size}",
         "cells_steps_per_sec": round(cells_steps_per_sec, 1),
         "steps": n_steps,

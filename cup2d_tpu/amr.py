@@ -203,6 +203,12 @@ class AMRSim(ShapeHostMixin):
         self.compute_forces_every = 1   # 0 disables the diagnostics pass
         self.force_log = None           # file-like, CSV rows
         self.timers = None              # profiling.PhaseTimers, opt-in
+        # cumulative regrid activity + shard comm-volume stats for the
+        # telemetry stream (profiling.MetricsRecorder reports per-step
+        # deltas; _comm_stats is populated by ShardedAMRSim)
+        self._n_refined = 0
+        self._n_coarsened = 0
+        self._comm_stats = None
         # jitted ONCE; tables/order/h are arguments, so regrids that
         # reproduce previously-seen shapes hit the XLA compile cache
         self._step_jit = jax.jit(
@@ -387,6 +393,10 @@ class AMRSim(ShapeHostMixin):
         self._xc = jnp.asarray(xc, f.dtype)
         self._yc = jnp.asarray(yc, f.dtype)
         self._tables_version = f.version
+        # charge the async table/constant device_puts to "tables", not
+        # to the first step that consumes them
+        (self.timers or NULL_TIMERS).fence(
+            "tables", self._tables, self._corr)
 
     def _build_coarse_maps(self, n_pad: int, n_real: int):
         """Host build of the two-level transfer structure (see
@@ -619,7 +629,8 @@ class AMRSim(ShapeHostMixin):
         the initial guess p_old) is the makeFlux variable-resolution
         closure — conservative on both sides of every interface.
         ``chi``/``udef_b`` add the -chi div(u_def) obstacle term.
-        All operands ordered compact; returns (v_new, p_new, res)."""
+        All operands ordered compact; returns
+        (v_new, p_new, res, div_linf)."""
         cfg = self.cfg
         ih2 = 1.0 / (h * h)
         pord = pres[:, 0] * maskv[:, 0]          # [N,BS,BS]
@@ -632,6 +643,16 @@ class AMRSim(ShapeHostMixin):
             b = b - fac * chi * divergence(ulab, 1)
         b = apply_flux_corr(
             b, divergence_deposits(vlab, ulab, chi, fac[:, 0, 0]), corr)
+        # physics invariant for the telemetry watchdog: max |∇·u| of
+        # the pre-projection velocity, read off the (flux-corrected)
+        # Poisson RHS the step already forms — |b| = fac * |undivided
+        # div| with fac = h/2dt, physical div = undivided/(2h), so the
+        # rescale is dt/h^2 per block. Zero extra lab assemblies (an
+        # honest post-projection divergence would cost one more halo
+        # exchange per step under the sharded mesh). Pad rows carry
+        # stale-but-finite lab data — masked.
+        div_linf = jnp.max(
+            jnp.abs(b) * maskv[:, 0] * (dt / (h[:, 0] * h[:, 0])))
 
         if hasattr(tpois, "nba"):
             # structured per-face operator (flux.poisson_apply_structured)
@@ -813,7 +834,15 @@ class AMRSim(ShapeHostMixin):
         dv = apply_flux_corr(
             dv, gradient_deposits(plab[:, 0], pfac), corr)
         v = (v + dv * ih2) * maskv
-        return v, p_new[:, None], res
+        return v, p_new[:, None], res, div_linf
+
+    def _energy(self, v, hsq):
+        """Kinetic energy of the masked ordered velocity — the
+        telemetry watchdog's first invariant, one fused reduction
+        riding the step's existing diag (pad rows carry hsq = 0).
+        Accumulated in sum_dtype like the Krylov dots."""
+        vv = v.astype(self.sum_dtype) if self.sum_dtype is not None else v
+        return 0.5 * jnp.sum(vv * vv * hsq[:, None].astype(vv.dtype))
 
     @staticmethod
     def _finite_flag(v, p_new, maskv):
@@ -834,7 +863,7 @@ class AMRSim(ShapeHostMixin):
                    t3, t1v, t1s, tpois, corr, tcoarse,
                    exact_poisson=False):
         v = self._advect_rk2(vel, h, dt, t3, corr, maskv)
-        v, p_new, res = self._pressure_project(
+        v, p_new, res, div_linf = self._pressure_project(
             v, pres, dt, h, hsq, t1v, t1s, tpois, corr, tcoarse,
             exact_poisson, maskv)
         diag = {
@@ -844,6 +873,8 @@ class AMRSim(ShapeHostMixin):
             "poisson_converged": res.converged,
             "finite": self._finite_flag(v, p_new, maskv),
             "umax": jnp.max(jnp.abs(v)),
+            "energy": self._energy(v, hsq),
+            "div_linf": div_linf,
         }
         return v, p_new, diag
 
@@ -903,7 +934,7 @@ class AMRSim(ShapeHostMixin):
         v = v_cf.transpose(1, 0, 2, 3)
 
         udef = self._combined_udef(obs)  # [2,N,BS,BS]
-        v, p_new, res = self._pressure_project(
+        v, p_new, res, div_linf = self._pressure_project(
             v, pres, dt, h, hsq, t1v, t1s, tpois, corr, tcoarse,
             exact_poisson, maskv,
             chi=obs.chi, udef_b=udef.transpose(1, 0, 2, 3))
@@ -914,6 +945,8 @@ class AMRSim(ShapeHostMixin):
             "poisson_converged": res.converged,
             "finite": self._finite_flag(v, p_new, maskv),
             "umax": jnp.max(jnp.abs(v)),
+            "energy": self._energy(v, hsq),
+            "div_linf": div_linf,
         }
         return v, p_new, uvw, diag
 
@@ -1471,8 +1504,7 @@ class AMRSim(ShapeHostMixin):
                     # spuriously trip the production trigger on
                     # compressed forests (code-review r4)
                     self._last_iters_dev = diag["poisson_iters"]
-                if self.timers is not None:
-                    jax.block_until_ready(vel)  # charge flow to "flow"
+                tm.fence("flow", vel)   # charge flow to "flow"
             self.time += dt
             self.step_count += 1
             return diag
@@ -1555,6 +1587,8 @@ class AMRSim(ShapeHostMixin):
             # the ONE host pull of the step
             uvw, com, mass, inertia, dt_next, diag, forces = \
                 jax.device_get((*scalars, forces))
+            # the scalar pull alone does not prove the fields landed
+            tm.fence("flow", vel)
         self._sync_shape_scalars_np(com, mass, inertia)
         uvw_np = np.asarray(uvw, dtype=np.float64)
         for k, s in enumerate(self.shapes):
@@ -1788,6 +1822,9 @@ class AMRSim(ShapeHostMixin):
             jnp.asarray(parents), jnp.asarray(child_slots.reshape(-1)),
             jnp.asarray(sib_slots), jnp.asarray(parent_slots),
             self._tables["vec1t"], self._tables["sca1t"]))
+        self._n_refined += R
+        self._n_coarsened += G
+        (self.timers or NULL_TIMERS).fence("adapt", dict(f.fields))
 
     def _regrid_apply_impl(self, fields, order, parents, child_slots,
                            sib_slots, parent_slots, tv, ts):

@@ -15,6 +15,12 @@ Spec syntax — comma-separated directives, ``name[@STEP][*COUNT]``::
                           reduction must catch it and the guard rewind
     inf_vel@N[*K]         same with +Inf (the pre-guard driver check
                           ``umax != umax`` famously missed Inf)
+    scale_vel@N[*K]       wrong-but-FINITE corruption: scale the whole
+                          velocity field x10 before step N — every
+                          number stays finite, so the isfinite verdict
+                          passes; only the physics-invariant watchdog
+                          (resilience.PhysicsWatchdog: x10 umax,
+                          x100 energy) catches it
     poisson_giveup@N[*K]  report step N's pressure solve as failed
                           (forced BiCGSTAB give-up seen by the verdict)
     sigterm@N             deliver SIGTERM to this process after step N
@@ -50,9 +56,11 @@ class FaultPlan:
     re-fault unless the spec asked for it with ``*K``."""
 
     _POISON = {"nan_vel": float("nan"), "inf_vel": float("inf")}
+    _SCALE = 10.0      # scale_vel factor (x100 in energy)
 
     def __init__(self, spec: str = ""):
         self.vel_poison: dict[int, list] = {}   # step -> [value, count]
+        self.vel_scale: dict[int, list] = {}    # step -> [factor, count]
         self.giveup: dict[int, int] = {}        # step -> count
         self.sigterm_steps: set[int] = set()
         self.crash_points: dict[str, int] = {}  # name -> count
@@ -73,6 +81,10 @@ class FaultPlan:
                 if step is None:
                     raise ValueError(f"{name} needs @STEP")
                 self.vel_poison[step] = [self._POISON[name], count]
+            elif name == "scale_vel":
+                if step is None:
+                    raise ValueError("scale_vel needs @STEP")
+                self.vel_scale[step] = [self._SCALE, count]
             elif name == "poisson_giveup":
                 if step is None:
                     raise ValueError("poisson_giveup needs @STEP")
@@ -86,7 +98,7 @@ class FaultPlan:
             else:
                 raise ValueError(
                     f"unknown fault directive {name!r} "
-                    "(expected nan_vel|inf_vel|poisson_giveup|"
+                    "(expected nan_vel|inf_vel|scale_vel|poisson_giveup|"
                     "sigterm|crash_in_save)")
 
     @classmethod
@@ -95,19 +107,26 @@ class FaultPlan:
         return cls(os.environ.get("CUP2D_FAULTS", ""))
 
     def __bool__(self) -> bool:
-        return bool(self.vel_poison or self.giveup or self.sigterm_steps
-                    or self.crash_points)
+        return bool(self.vel_poison or self.vel_scale or self.giveup
+                    or self.sigterm_steps or self.crash_points)
 
     # -- hooks consulted by the guard / io ----------------------------
     def apply_pre_step(self, sim) -> bool:
-        """Poison the velocity before an attempt of the current step.
-        Returns whether a fault fired (and consumed one count)."""
+        """Poison or scale the velocity before an attempt of the
+        current step. Returns whether a fault fired (and consumed one
+        count)."""
+        fired = False
         ent = self.vel_poison.get(sim.step_count)
-        if not ent or ent[1] <= 0:
-            return False
-        ent[1] -= 1
-        poison_velocity(sim, ent[0])
-        return True
+        if ent and ent[1] > 0:
+            ent[1] -= 1
+            poison_velocity(sim, ent[0])
+            fired = True
+        ent = self.vel_scale.get(sim.step_count)
+        if ent and ent[1] > 0:
+            ent[1] -= 1
+            scale_velocity(sim, ent[0])
+            fired = True
+        return fired
 
     def poisson_giveup_at(self, step: int) -> bool:
         """Consume one forced-give-up count for ``step`` if armed."""
@@ -141,6 +160,18 @@ def poison_velocity(sim, value: float) -> None:
     else:
         sim.state = sim.state._replace(
             vel=sim.state.vel.at[0, 0, 0].set(value))
+
+
+def scale_velocity(sim, factor: float) -> None:
+    """Multiply the whole velocity field by ``factor`` — every value
+    stays finite (the wrong-but-finite corruption class the isfinite
+    verdict cannot see), through the same supported write paths as
+    :func:`poison_velocity`."""
+    if hasattr(sim, "forest"):
+        ordf = sim._ordered_state()
+        sim._set_ordered(vel=ordf["vel"] * factor)
+    else:
+        sim.state = sim.state._replace(vel=sim.state.vel * factor)
 
 
 # -- process-wide plan (the CLI arms it; io.py's crash window asks) ---

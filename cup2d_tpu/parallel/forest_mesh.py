@@ -97,8 +97,9 @@ class ShardedAMRSim(AMRSim):
         collective at all), and only the faces that actually cross a
         shard boundary keep gather rows riding the surface exchange —
         the round-5 paint at round-4 communication volume."""
-        from .shard_halo import shard_tables
+        from .shard_halo import exchange_padding_stats, shard_tables
         if n_pad % self.mesh.devices.size:
+            self._comm_stats = None
             return super()._finalize_tables(raw, n_pad, fc=None)
         from ..halo import pad_tables
         repl = NamedSharding(self.mesh, P())
@@ -113,6 +114,19 @@ class ShardedAMRSim(AMRSim):
                     kw = dict(fc=fc, corners=self._FAST_SETS[k])
                 out[k] = shard_tables(t, n_pad, self.mesh, mode=mode,
                                       **kw)
+        if "vec3" in raw:
+            # comm volume for the telemetry stream: real vs on-the-wire
+            # bytes of ONE hot-loop vector exchange under the current
+            # plan (host-only audit, rebuilt per regrid like the tables
+            # themselves; the same numbers test_comm_volume bounds)
+            st = exchange_padding_stats(
+                raw["vec3"], n_pad, self.mesh.devices.size, mode=mode)
+            blk = 2 * self.cfg.bs * self.cfg.bs \
+                * np.dtype(jnp.dtype(self.forest.dtype).name).itemsize
+            self._comm_stats = {
+                "halo_real_bytes": st["real_blocks"] * blk,
+                "halo_padded_bytes": st["padded_blocks"] * blk,
+            }
         return out
 
     def _build_pois(self, topo, n_pad):

@@ -9,11 +9,20 @@ as one matplotlib PolyCollection — mixed-level AMR dumps render
 naturally because the format is per-cell quads (each cell carries its
 own geometry, so resolution can vary freely).
 
+``--metrics`` switches to the telemetry reporter: summarize a run's
+``metrics.jsonl`` stream (profiling.MetricsRecorder schema) as one JSON
+line per file — solver iteration stats, dt/wall distributions, energy
+endpoints, divergence peak, recompile/transfer counters, final AMR
+shape. BENCH_*.json embeds the same summary shape (bench.py), so a
+bench result and a production run read as one trajectory.
+
 Usage:  python -m cup2d_tpu.post out/vel.0000001234.xdmf2 [...]
+        python -m cup2d_tpu.post --metrics out/metrics.jsonl [...]
 """
 
 from __future__ import annotations
 
+import json
 import sys
 
 import numpy as np
@@ -50,12 +59,30 @@ def render(path: str, png_path: str | None = None,
     return out
 
 
+def metrics_summary(path: str) -> dict:
+    """Aggregate one metrics.jsonl stream (profiling.summarize_metrics
+    + the source path)."""
+    from .profiling import load_metrics, summarize_metrics
+
+    out = summarize_metrics(load_metrics(path))
+    out["source"] = path
+    return out
+
+
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     if not args:
-        print("usage: python -m cup2d_tpu.post <dump>[.xdmf2] ...",
-              file=sys.stderr)
+        print("usage: python -m cup2d_tpu.post <dump>[.xdmf2] ... | "
+              "--metrics <metrics.jsonl> ...", file=sys.stderr)
         return 2
+    if args[0] == "--metrics":
+        if not args[1:]:
+            print("usage: python -m cup2d_tpu.post --metrics "
+                  "<metrics.jsonl> ...", file=sys.stderr)
+            return 2
+        for a in args[1:]:
+            print(json.dumps(metrics_summary(a)))
+        return 0
     for a in args:
         print(render(a))
     return 0

@@ -1,24 +1,56 @@
-"""Profiling and observability (SURVEY.md §5: the reference has NO
-timers, counters, or traces — a stderr step counter only).
+"""Telemetry & profiling (SURVEY.md §5: the reference has NO timers,
+counters, metrics or traces — a stderr step counter only).
 
-Three tools:
-- ``PhaseTimers``: per-phase wall-clock accumulation. Instrumented
-  code must synchronize inside each phase (the sims block on the
-  phase's device outputs whenever timers are enabled) — without that,
-  async dispatch attributes device time to whoever synchronizes next.
-  Enable on a sim with ``sim.timers = PhaseTimers()``; `report()`
+The run-telemetry subsystem (PR 3), layered so every piece rides work
+the step ALREADY does — the contract throughout is **zero extra device
+syncs**: the per-step scalars arrive in the step's one existing batched
+diag pull (`sim.py`/`amr.py`), and everything here is host-side
+bookkeeping on top of it (asserted by ``tests/test_telemetry.py``: a
+metrics-on run is bit-identical to metrics-off with equal
+``device_get`` counts).
+
+- :class:`MetricsRecorder`: one structured record per step — solver
+  health (Poisson iters / true residual / converged / stalled),
+  timestep state (dt, umax, next dt), fused on-device physics
+  invariants (kinetic energy, max |∇·u|), AMR shape (per-level block
+  histogram, refine/coarsen counts), comm volume (real/padded halo
+  bytes of the shard exchange plan), host counters (jit recompiles,
+  ``device_get`` pulls, HBM high-water mark) and per-phase wall times —
+  streamed as JSONL through the PR-2 ``resilience.EventLog`` machinery
+  (process-0 writer on pods). The key set is frozen
+  (:data:`METRICS_KEYS`, schema-stability golden test).
+- :class:`HostCounters`: process-wide host-side counters — jit
+  recompiles via the ``jax.monitoring`` backend-compile event,
+  device→host pulls by wrapping ``jax.device_get``, HBM peak bytes via
+  ``jax.local_devices()[0].memory_stats()`` (None on backends without
+  an allocator report, e.g. CPU).
+- :class:`TraceWindow`: windowed device tracing —
+  ``CUP2D_TRACE=start:stop[:logdir]`` wraps exactly steps
+  ``[start, stop)`` of a production run in ``jax.profiler`` so a
+  TensorBoard trace costs only its window, not the whole run.
+- :class:`PhaseTimers`: per-phase wall-clock accumulation. Instrumented
+  code must synchronize inside each phase — pass the phase's device
+  outputs through :meth:`PhaseTimers.fence` (without that, async
+  dispatch attributes device time to whoever synchronizes next).
+  Enable on a sim with ``sim.timers = PhaseTimers()``; ``report()``
   gives totals, means, and counts per phase.
 - ``throughput(sim)``: the north-star cells*steps/s metric from a sim's
   counters (works for uniform and forest sims).
 - ``trace(logdir)``: context manager around `jax.profiler` for a full
-  TensorBoard-readable device trace.
+  TensorBoard-readable device trace (whole-block form; production runs
+  want :class:`TraceWindow`).
 """
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from collections import defaultdict
 from contextlib import contextmanager
+from typing import Optional
+
+import numpy as np
 
 import jax
 
@@ -40,6 +72,18 @@ class PhaseTimers:
         finally:
             self.acc[name] += time.perf_counter() - t0
             self.count[name] += 1
+
+    def fence(self, name: str, *arrays):
+        """Block until ``arrays`` (arrays or pytrees of arrays) are
+        ready, so the enclosing ``phase(name)`` block charges their
+        device time to the right phase instead of to whoever
+        synchronizes next. Call INSIDE the phase block; returns the
+        arrays unchanged so it can wrap a phase's outputs in place.
+        Non-jax leaves (numpy tables) pass through untouched."""
+        for a in arrays:
+            if a is not None:
+                jax.block_until_ready(a)
+        return arrays
 
     def report(self) -> dict:
         return {
@@ -105,3 +149,393 @@ def trace(logdir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+# ---------------------------------------------------------------------------
+# windowed device tracing (CUP2D_TRACE=start:stop[:logdir])
+# ---------------------------------------------------------------------------
+
+class TraceWindow:
+    """Windowed `jax.profiler` tracing driven by the step counter: the
+    trace wraps exactly steps ``[start, stop)``, so a production run
+    captures a TensorBoard trace of a few warmed steps without paying
+    profiler overhead (or trace-file volume) for the whole run.
+
+    The driver calls :meth:`maybe_start` BEFORE attempting a step and
+    :meth:`maybe_stop` AFTER it completes (with the post-step counter);
+    ``>=`` comparisons keep a restarted run from arming a window its
+    step range already passed. :meth:`close` stops a still-open trace
+    at loop exit (a window past ``tend`` must not leave the profiler
+    running)."""
+
+    def __init__(self, start: int, stop: int, logdir: str = "trace"):
+        if not (0 <= int(start) < int(stop)):
+            raise ValueError(
+                f"trace window needs 0 <= start < stop, got "
+                f"{start}:{stop}")
+        self.start = int(start)
+        self.stop = int(stop)
+        self.logdir = logdir
+        self.active = False
+        self.done = False
+
+    @classmethod
+    def from_env(cls) -> Optional["TraceWindow"]:
+        """Latch CUP2D_TRACE once (the sanctioned read site — see
+        tests/test_env_latch.py). A typo'd spec raises instead of
+        silently arming nothing (the CUP2D_FAULTS principle: a trace
+        window that never fires measures nothing)."""
+        spec = os.environ.get("CUP2D_TRACE", "")
+        if not spec:
+            return None
+        parts = spec.split(":", 2)
+        if len(parts) < 2:
+            raise ValueError(
+                f"CUP2D_TRACE={spec!r}: expected start:stop[:logdir]")
+        try:
+            start, stop = int(parts[0]), int(parts[1])
+        except ValueError:
+            raise ValueError(
+                f"CUP2D_TRACE={spec!r}: start/stop must be integers")
+        logdir = parts[2] if len(parts) == 3 and parts[2] else "trace"
+        return cls(start, stop, logdir)
+
+    def maybe_start(self, step_count: int) -> None:
+        """Arm the trace before stepping ``step_count`` if the window
+        opens here."""
+        if self.active or self.done or step_count < self.start \
+                or step_count >= self.stop:
+            return
+        jax.profiler.start_trace(self.logdir)
+        self.active = True
+        from .resilience import record_event
+        record_event(event="trace_start", step=step_count,
+                     logdir=self.logdir)
+
+    def maybe_stop(self, step_count: int) -> None:
+        """Close the trace once the post-step counter reaches the
+        window end."""
+        if self.active and step_count >= self.stop:
+            self._stop(step_count)
+
+    def close(self) -> None:
+        if self.active:
+            self._stop(None)
+
+    def _stop(self, step_count) -> None:
+        jax.profiler.stop_trace()
+        self.active = False
+        self.done = True
+        from .resilience import record_event
+        record_event(event="trace_stop", step=step_count,
+                     logdir=self.logdir)
+
+
+# ---------------------------------------------------------------------------
+# host-side counters: jit recompiles, device_get pulls, HBM high-water
+# ---------------------------------------------------------------------------
+
+# active counter instances; the jax-level hooks dispatch to whatever is
+# active (jax.monitoring has no per-listener deregistration, and
+# un-wrapping jax.device_get under someone else's later monkeypatch
+# would drop their wrapper — the pass-through hooks are inert while no
+# counter is active)
+_ACTIVE_COUNTERS: list = []
+_LISTENER_ON = False
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _on_compile(event, duration, **kw):
+    if event == _COMPILE_EVENT:
+        for c in _ACTIVE_COUNTERS:
+            c.jit_compiles += 1
+
+
+def _install_hooks() -> None:
+    global _LISTENER_ON
+    if not _LISTENER_ON:
+        _LISTENER_ON = True
+        jax.monitoring.register_event_duration_secs_listener(_on_compile)
+    # marker-checked (not a one-shot flag): a test monkeypatch that
+    # saved/restored jax.device_get around an install would otherwise
+    # silently unwind the wrapper forever
+    if not getattr(jax.device_get, "_cup2d_counting", False):
+        orig = jax.device_get
+
+        def _counting_device_get(x):
+            for c in _ACTIVE_COUNTERS:
+                c.device_gets += 1
+            return orig(x)
+
+        _counting_device_get._cup2d_counting = True
+        jax.device_get = _counting_device_get
+
+
+def hbm_peak_bytes() -> Optional[int]:
+    """HBM high-water mark of the first local device, or None where the
+    backend reports no allocator stats (CPU)."""
+    try:
+        ms = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not ms:
+        return None
+    peak = ms.get("peak_bytes_in_use")
+    return int(peak) if peak is not None else None
+
+
+class HostCounters:
+    """Host-side observability counters for one run.
+
+    - ``jit_compiles``: XLA backend compiles since :meth:`install`
+      (the `jax.monitoring` backend-compile event — a steady-state step
+      must trigger ZERO of these; `tests/test_telemetry.py` guards it).
+    - ``device_gets``: explicit device→host pulls (`jax.device_get`
+      calls — the drivers' batched per-step pull discipline makes this
+      exactly one per step on the hot paths).
+    - HBM high-water via :func:`hbm_peak_bytes` (absolute, not delta:
+      the allocator reports a process-lifetime peak).
+
+    ``install``/``uninstall`` only toggle membership in the active set;
+    the underlying hooks are process-wide pass-throughs (see
+    ``_install_hooks``) and never removed."""
+
+    def __init__(self):
+        self.jit_compiles = 0
+        self.device_gets = 0
+
+    def install(self) -> "HostCounters":
+        _install_hooks()
+        if self not in _ACTIVE_COUNTERS:
+            _ACTIVE_COUNTERS.append(self)
+        return self
+
+    def uninstall(self) -> None:
+        if self in _ACTIVE_COUNTERS:
+            _ACTIVE_COUNTERS.remove(self)
+
+    def snapshot(self) -> dict:
+        return {"jit_compiles": self.jit_compiles,
+                "device_gets": self.device_gets}
+
+
+# ---------------------------------------------------------------------------
+# the per-step metrics stream
+# ---------------------------------------------------------------------------
+
+# THE frozen record key set (schema-stability golden test). Keys are
+# always present; fields that do not apply to a path (AMR shape on a
+# uniform run, comm volume on a single device, counters when disabled)
+# are null — consumers key on names, never on presence.
+METRICS_SCHEMA_VERSION = 1
+METRICS_KEYS = (
+    "schema", "step", "t", "dt", "wall_ms",
+    # solver health + timestep state (the step's existing diag pull)
+    "umax", "dt_next",
+    "poisson_iters", "poisson_residual",
+    "poisson_converged", "poisson_stalled",
+    # fused on-device physics invariants (watchdog inputs)
+    "energy", "div_linf",
+    # AMR shape
+    "n_blocks", "blocks_per_level", "refines", "coarsens",
+    # comm volume (shard surface-exchange plan, per one vec3 exchange)
+    "halo_real_bytes", "halo_padded_bytes",
+    # host-side counters (per-step deltas; hbm peak is absolute)
+    "jit_compiles", "device_gets", "hbm_peak_bytes",
+    # merged PhaseTimers wall times (per-step deltas, ms)
+    "phase_ms",
+)
+
+_DIAG_KEYS = ("umax", "dt_next", "poisson_iters", "poisson_residual",
+              "poisson_converged", "poisson_stalled", "energy",
+              "div_linf")
+
+_INT_KEYS = {"poisson_iters"}
+_BOOL_KEYS = {"poisson_converged", "poisson_stalled"}
+
+
+def _jsonable(key: str, v):
+    if v is None:
+        return None
+    if key in _INT_KEYS:
+        return int(v)
+    if key in _BOOL_KEYS:
+        return bool(v)
+    return float(v)
+
+
+class MetricsRecorder:
+    """Assembles one :data:`METRICS_KEYS` record per step and streams
+    it through ``sink`` (a ``resilience.EventLog`` — process-0 JSONL,
+    unified with the PR-2 event stream; ``None`` returns records
+    without writing, the bench path).
+
+    The record costs no device work: every diag scalar arrives in the
+    step's one existing batched pull (on library paths that keep diag
+    scalars on device, ONE `device_get` fetches the union — same policy
+    as ``resilience.health_verdict``), the AMR histogram is host numpy
+    cached per topology version, and counters/timers are host state."""
+
+    def __init__(self, sink=None, counters: Optional[HostCounters] = None,
+                 timers: Optional[PhaseTimers] = None):
+        self.sink = sink
+        self.counters = counters
+        self.timers = timers
+        self._last_time: Optional[float] = None
+        self._last_counters = counters.snapshot() if counters else None
+        self._last_phase: dict = dict(timers.acc) if timers else {}
+        self._last_regrid = (0, 0)
+        self._lvl_cache = (None, None, None)   # (version, hist, n)
+
+    def prime(self, sim) -> None:
+        """Anchor the dt baseline to the sim's current time (call once
+        before the loop; the first record's dt is null otherwise)."""
+        self._last_time = float(sim.time)
+        if hasattr(sim, "_n_refined"):
+            self._last_regrid = (sim._n_refined, sim._n_coarsened)
+
+    # -- assembly ------------------------------------------------------
+    def record(self, sim, diag: dict, wall_ms: Optional[float] = None
+               ) -> dict:
+        """One record from a driver sim (uniform or forest) after a
+        completed step; emits into the sink and returns the record."""
+        rec = self.record_step(
+            step=sim.step_count, t=float(sim.time), diag=diag,
+            wall_ms=wall_ms, sim=sim)
+        return rec
+
+    def record_step(self, *, step: int, t: float, diag: dict,
+                    wall_ms: Optional[float] = None, sim=None,
+                    dt: Optional[float] = None) -> dict:
+        vals = {k: diag[k] for k in _DIAG_KEYS if k in diag}
+        if any(isinstance(v, jax.Array) for v in vals.values()):
+            vals = jax.device_get(vals)   # library-path fallback: 1 pull
+        if dt is None:
+            dt = (t - self._last_time) if self._last_time is not None \
+                else None
+        self._last_time = t
+        rec = {
+            "schema": METRICS_SCHEMA_VERSION,
+            "step": int(step),
+            "t": float(t),
+            "dt": float(dt) if dt is not None else None,
+            "wall_ms": round(wall_ms, 3) if wall_ms is not None else None,
+        }
+        for k in _DIAG_KEYS:
+            rec[k] = _jsonable(k, vals.get(k))
+        rec.update(self._amr_fields(sim))
+        rec.update(self._comm_fields(sim))
+        rec.update(self._counter_fields())
+        rec["phase_ms"] = self._phase_fields()
+        if self.sink is not None:
+            self.sink.emit(event="metrics", **rec)
+        return rec
+
+    def _amr_fields(self, sim) -> dict:
+        f = getattr(sim, "forest", None)
+        if f is None:
+            return {"n_blocks": None, "blocks_per_level": None,
+                    "refines": None, "coarsens": None}
+        if self._lvl_cache[0] != f.version:
+            order = getattr(sim, "_order", None)
+            if order is None:
+                order = f.order()
+            lv, cnt = np.unique(f.level[order], return_counts=True)
+            hist = {str(int(l)): int(c) for l, c in zip(lv, cnt)}
+            self._lvl_cache = (f.version, hist, int(len(order)))
+        nr = getattr(sim, "_n_refined", 0)
+        nc = getattr(sim, "_n_coarsened", 0)
+        ref_d = nr - self._last_regrid[0]
+        coa_d = nc - self._last_regrid[1]
+        self._last_regrid = (nr, nc)
+        return {"n_blocks": self._lvl_cache[2],
+                "blocks_per_level": self._lvl_cache[1],
+                "refines": ref_d, "coarsens": coa_d}
+
+    def _comm_fields(self, sim) -> dict:
+        st = getattr(sim, "_comm_stats", None)
+        if not st:
+            return {"halo_real_bytes": None, "halo_padded_bytes": None}
+        return {"halo_real_bytes": int(st["halo_real_bytes"]),
+                "halo_padded_bytes": int(st["halo_padded_bytes"])}
+
+    def _counter_fields(self) -> dict:
+        if self.counters is None:
+            return {"jit_compiles": None, "device_gets": None,
+                    "hbm_peak_bytes": None}
+        cur = self.counters.snapshot()
+        last = self._last_counters or {k: 0 for k in cur}
+        self._last_counters = cur
+        return {
+            "jit_compiles": cur["jit_compiles"] - last["jit_compiles"],
+            "device_gets": cur["device_gets"] - last["device_gets"],
+            "hbm_peak_bytes": hbm_peak_bytes(),
+        }
+
+    def _phase_fields(self) -> Optional[dict]:
+        if self.timers is None:
+            return None
+        cur = dict(self.timers.acc)
+        out = {k: round(1e3 * (v - self._last_phase.get(k, 0.0)), 3)
+               for k, v in cur.items()
+               if v - self._last_phase.get(k, 0.0) > 0.0}
+        self._last_phase = cur
+        return out
+
+
+def load_metrics(path: str) -> list:
+    """All JSONL records from ``path`` (mixed event streams are fine;
+    `summarize_metrics` filters for ``event == "metrics"``)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def summarize_metrics(records: list) -> dict:
+    """Aggregate a metrics stream (list of record dicts — from
+    :func:`load_metrics` or directly from a recorder) into the summary
+    `python -m cup2d_tpu.post --metrics` prints and `bench.py` embeds."""
+    recs = [r for r in records if r.get("event", "metrics") == "metrics"]
+
+    def col(key):
+        return [r[key] for r in recs if r.get(key) is not None]
+
+    def stats(xs):
+        if not xs:
+            return None
+        return {"mean": round(float(np.mean(xs)), 6),
+                "max": round(float(np.max(xs)), 6)}
+
+    energy = col("energy")
+    out = {
+        "schema": METRICS_SCHEMA_VERSION,
+        "steps": len(recs),
+        "t_first": recs[0]["t"] if recs else None,
+        "t_final": recs[-1]["t"] if recs else None,
+        "dt": stats(col("dt")),
+        "wall_ms": stats(col("wall_ms")),
+        "poisson_iters": stats(col("poisson_iters")),
+        "poisson_residual_max": (max(col("poisson_residual"))
+                                 if col("poisson_residual") else None),
+        "energy_first": energy[0] if energy else None,
+        "energy_last": energy[-1] if energy else None,
+        "div_linf_max": (max(col("div_linf"))
+                         if col("div_linf") else None),
+        "jit_compiles_total": (sum(col("jit_compiles"))
+                               if col("jit_compiles") else None),
+        "device_gets_per_step": stats(col("device_gets")),
+        "hbm_peak_bytes": (max(col("hbm_peak_bytes"))
+                           if col("hbm_peak_bytes") else None),
+        "n_blocks_last": (col("n_blocks")[-1]
+                          if col("n_blocks") else None),
+        "refines_total": (sum(col("refines"))
+                          if col("refines") else None),
+        "coarsens_total": (sum(col("coarsens"))
+                           if col("coarsens") else None),
+    }
+    return out

@@ -25,6 +25,10 @@ in ``io.py`` — this module is the supervision half on top of it:
 
   Every rung emits one JSONL event (step, verdict, action) through
   :class:`EventLog`.
+- :class:`PhysicsWatchdog`: windowed drift bounds on the fused physics
+  invariants (kinetic energy, max |∇·u|) the diag pull carries since
+  PR 3 — catches wrong-but-FINITE corruption the isfinite verdict
+  cannot (the former ROADMAP open item), feeding the same ladder.
 - :class:`PreemptionGuard`: SIGTERM latches a flag; the driver loop
   checkpoints at the next step boundary and exits 0 (preemptible-pod
   semantics: the grace window is spent writing the restart point, not
@@ -136,10 +140,29 @@ class StepVerdict(NamedTuple):
     ok: bool
     reason: str           # "ok" | "nonfinite" | "poisson_nonfinite"
     #                     | "poisson_exhausted" | "poisson_giveup(injected)"
+    #                     | "invariant_umax" | "invariant_energy"
+    #                     | "invariant_divergence"
 
 
 _HEALTH_KEYS = ("finite", "umax", "poisson_converged", "poisson_stalled",
                 "poisson_residual")
+
+# the fused on-device physics invariants (uniform.step_diag /
+# amr._step_impl): watchdog inputs, riding the same batched diag pull
+_INVARIANT_KEYS = ("energy", "div_linf")
+
+
+def _host_scalars(diag: dict, keys) -> dict:
+    """The named diag entries as host scalars. On the CLI driver paths
+    every value is already host-side (batched into the step's one
+    existing pull); library paths that keep scalars on device pay ONE
+    ``device_get`` for the whole set."""
+    import jax
+
+    vals = {k: diag[k] for k in keys if k in diag}
+    if any(isinstance(v, jax.Array) for v in vals.values()):
+        vals = jax.device_get(vals)
+    return vals
 
 
 def health_verdict(diag: dict,
@@ -166,11 +189,7 @@ def health_verdict(diag: dict,
     device array (library paths that keep scalars on device, e.g. the
     obstacle-free AMR step), they are fetched in ONE device_get.
     """
-    import jax
-
-    vals = {k: diag[k] for k in _HEALTH_KEYS if k in diag}
-    if any(isinstance(v, jax.Array) for v in vals.values()):
-        vals = jax.device_get(vals)
+    vals = _host_scalars(diag, _HEALTH_KEYS)
     finite = vals.get("finite")
     if finite is None:
         u = float(vals.get("umax", 0.0))
@@ -188,6 +207,124 @@ def health_verdict(diag: dict,
         if residual_ok is None or not (rf <= residual_ok):
             return StepVerdict(False, "poisson_exhausted")
     return StepVerdict(True, "ok")
+
+
+# ---------------------------------------------------------------------------
+# physics-invariant watchdog (the silent-corruption gap, ROADMAP)
+# ---------------------------------------------------------------------------
+
+class PhysicsWatchdog:
+    """Windowed drift bounds on the fused physics invariants (umax,
+    kinetic energy, max |∇·u|) that every step's diag already carries.
+
+    The health verdict's isfinite reduction catches NaN/Inf, but
+    wrong-but-FINITE fields (a bit-flipped exponent, a corrupted halo
+    exchange, a stale buffer reinstalled by a bad restore) sail through
+    it — the ROADMAP open item this closes. Physics pins them down: a
+    viscous box flow cannot multiply its velocity scale or kinetic
+    energy inside one step, and advection bounds the divergence
+    production, so a step whose invariants jump far outside the recent
+    window is corrupt even though every number in it is finite.
+
+    Policy (deliberately loose — a FALSE positive costs a rewind-retry
+    and forks the trajectory, so the bounds are orders of magnitude
+    above legitimate step-to-step variation):
+
+    - each invariant ARMS itself independently, and only once its
+      window is both full and SETTLED (window max/min <= its settle
+      ratio). Relative drift bounds are meaningless on an unsettled
+      signal: during spin-up from rest the kinetic energy legitimately
+      multiplies per step (measured on the deforming-fish case: a dt/2
+      retry lands 8x the window max while E is still ~1e-10), so an
+      unsettled invariant stays dormant rather than false-positive.
+      umax is the invariant that arms FIRST in practice — it is
+      body-velocity-dominated and near-constant from the first steps
+      even while the energy still ramps — so corruption is caught long
+      before the energy bound wakes up;
+    - umax: BAD when outside [window min / factor, factor x window max]
+      (``umax_factor``, settle ``umax_settle``);
+    - energy: same two-sided bound (``energy_factor``/``energy_settle``
+      — corruption can deflate as well as inflate; legitimate viscous
+      decay is a few % per step, never a 4x cliff inside an 8-step
+      window);
+    - divergence: BAD when max |∇·u| > ``div_factor`` x the window max
+      (one-sided — a too-CLEAN divergence is what the projection aims
+      for; settle ``div_settle``).
+
+    Drive it through :class:`StepGuard` (``watchdog=``): a flagged step
+    walks the same recovery ladder as a nonfinite one, and only steps
+    with an OK final verdict enter the window — a corrupted step can
+    never poison its own baseline. ``tests/test_telemetry.py`` injects
+    a wrong-but-finite field (``faults.py scale_vel``) and asserts the
+    flag + recovery; an unfaulted guarded run stays bit-identical."""
+
+    def __init__(self, window: int = 8,
+                 umax_factor: float = 4.0, umax_settle: float = 2.0,
+                 energy_factor: float = 4.0, energy_settle: float = 2.0,
+                 div_factor: float = 50.0, div_settle: float = 4.0):
+        self.window = int(window)
+        self.umax_factor = float(umax_factor)
+        self.umax_settle = float(umax_settle)
+        self.energy_factor = float(energy_factor)
+        self.energy_settle = float(energy_settle)
+        self.div_factor = float(div_factor)
+        self.div_settle = float(div_settle)
+        self.umax: deque = deque(maxlen=self.window)
+        self.energy: deque = deque(maxlen=self.window)
+        self.div: deque = deque(maxlen=self.window)
+
+    def _armed(self, hist: deque, settle: float):
+        """(hi, lo) when the invariant's window is full and settled,
+        else None — drift bounds only mean something against a stable
+        baseline."""
+        if len(hist) < self.window:
+            return None
+        hi, lo = max(hist), min(hist)
+        if lo <= 0.0 or hi > settle * lo:
+            return None
+        return hi, lo
+
+    def check(self, vals: dict) -> Optional[str]:
+        """Verdict reason for a drifted invariant, or None. ``vals``
+        holds host scalars (the guard pre-pulls them with the health
+        keys in one batch)."""
+        u = vals.get("umax")
+        band = self._armed(self.umax, self.umax_settle)
+        if u is not None and band is not None:
+            hi, lo = band
+            if not (lo / self.umax_factor <= float(u)
+                    <= self.umax_factor * hi):
+                return "invariant_umax"
+        e = vals.get("energy")
+        band = self._armed(self.energy, self.energy_settle)
+        if e is not None and band is not None:
+            hi, lo = band
+            if not (lo / self.energy_factor <= float(e)
+                    <= self.energy_factor * hi):
+                return "invariant_energy"
+        d = vals.get("div_linf")
+        band = self._armed(self.div, self.div_settle)
+        if d is not None and band is not None:
+            hi, _ = band
+            if float(d) > self.div_factor * hi:
+                return "invariant_divergence"
+        return None
+
+    def observe(self, vals: dict) -> None:
+        """Fold a GOOD step's invariants into the window."""
+        if vals.get("umax") is not None:
+            self.umax.append(float(vals["umax"]))
+        if vals.get("energy") is not None:
+            self.energy.append(float(vals["energy"]))
+        if vals.get("div_linf") is not None:
+            self.div.append(float(vals["div_linf"]))
+
+    def reset(self) -> None:
+        """Drop the window (after a disk restore the history describes
+        steps FORWARD of the restored point)."""
+        self.umax.clear()
+        self.energy.clear()
+        self.div.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -218,12 +355,15 @@ class StepGuard:
     recover : False = verdict-only mode (first bad verdict aborts, with
         the same post-mortem/event path — the supervised replacement
         for the old inline NaN check)
+    watchdog : PhysicsWatchdog consulted after the health verdict (a
+        drifted invariant walks the same recovery ladder; None skips
+        the invariant check)
     """
 
     def __init__(self, sim, *, ring: int = 1, ckpt_dir: Optional[str] = None,
                  postmortem_dir: Optional[str] = None,
                  event_log: Optional[EventLog] = None,
-                 faults=None, recover: bool = True):
+                 faults=None, recover: bool = True, watchdog=None):
         self.sim = sim
         self.ring: deque = deque(maxlen=max(1, int(ring)))
         self.ckpt_dir = ckpt_dir
@@ -231,7 +371,9 @@ class StepGuard:
         self.event_log = event_log
         self.faults = faults
         self.recover = recover
+        self.watchdog = watchdog
         self.recoveries = 0     # completed recovery actions (telemetry)
+        self._verdict_vals: dict = {}   # host scalars of the last verdict
 
     # -- snapshot machinery (io.py payload gather/install, RAM only) --
     def _snapshot(self):
@@ -272,7 +414,12 @@ class StepGuard:
                 self.ring.append(self._snapshot())
                 if self.faults is not None:
                     self.faults.fire_post_step(sim.step_count)
-                return diag
+                # return the verdict's already-pulled host scalars in
+                # place of any device originals: on library paths that
+                # keep diag on device (the obstacle-free AMR step) a
+                # downstream consumer (MetricsRecorder) would otherwise
+                # pay a SECOND device_get for the same values
+                return {**diag, **self._verdict_vals}
             dt_used = sim.time - t0
             action = self._next_action(rung)
             if action == "abort":
@@ -294,6 +441,10 @@ class StepGuard:
                 load_checkpoint(self.ckpt_dir, sim)
                 self.ring.clear()
                 self.ring.append(self._snapshot())
+                if self.watchdog is not None:
+                    # the window now describes steps FORWARD of the
+                    # restored point — stale as a baseline
+                    self.watchdog.reset()
                 retry_dt = None
             rung += 1
 
@@ -311,11 +462,23 @@ class StepGuard:
 
     def _verdict(self, diag: dict, step: int) -> StepVerdict:
         tol = float(getattr(self.sim.cfg, "poisson_tol", 0.0))
-        v = health_verdict(diag,
+        # ONE batched pull covers the health keys, the watchdog's
+        # invariants AND the iteration count (all host-side already on
+        # the CLI driver paths); kept for step() to merge into the
+        # returned diag so a downstream metrics consumer never re-pulls
+        vals = self._verdict_vals = _host_scalars(
+            diag, _HEALTH_KEYS + _INVARIANT_KEYS + ("poisson_iters",))
+        v = health_verdict(vals,
                            residual_ok=(100.0 * tol if tol > 0 else None))
+        if v.ok and self.watchdog is not None:
+            reason = self.watchdog.check(vals)
+            if reason is not None:
+                v = StepVerdict(False, reason)
         if v.ok and self.faults is not None \
                 and self.faults.poisson_giveup_at(step):
             v = StepVerdict(False, "poisson_giveup(injected)")
+        if v.ok and self.watchdog is not None:
+            self.watchdog.observe(vals)
         return v
 
     def _next_action(self, rung: int) -> str:

@@ -273,12 +273,12 @@ class Simulation(ShapeHostMixin):
             us = jnp.zeros_like(vel)
             udef = jnp.zeros_like(vel)
 
-        vel, pres, res = g.project(
+        vel, pres, res, div_linf = g.project(
             vel, state.pres, obs.chi, udef, dt, exact_poisson)
 
         new_state = state._replace(vel=vel, pres=pres, chi=obs.chi,
                                    us=us, udef=udef)
-        return new_state, uvw, g.step_diag(vel, pres, res)
+        return new_state, uvw, g.step_diag(vel, pres, res, div_linf)
 
     # ------------------------------------------------------------------
     # device: surface force diagnostics (main.cpp:7188-7284)
@@ -369,6 +369,7 @@ class Simulation(ShapeHostMixin):
                 # health verdict then reads pure host scalars for free
                 diag = jax.device_get(diag)
                 self._next_dt = float(diag["dt_next"])
+                tm.fence("flow", self.state)
             self.time += dt
             self.step_count += 1
             return diag
@@ -392,6 +393,10 @@ class Simulation(ShapeHostMixin):
         with tm.phase("rasterize"):
             obs = self._rasterize(self._shape_inputs())
             self._sync_shape_scalars(obs)
+            # fence the field outputs too (the scalar pull above only
+            # proves the scalars landed): device raster time must land
+            # in THIS phase, not in whoever synchronizes next
+            tm.fence("rasterize", obs)
 
         prescribed = jnp.asarray(
             [[s.u, s.v, s.omega] for s in self.shapes], dtype=g.dtype
@@ -407,6 +412,10 @@ class Simulation(ShapeHostMixin):
             uvw_np, diag = jax.device_get((uvw, diag))
             uvw_np = np.asarray(uvw_np, dtype=np.float64)
             self._next_dt = float(diag["dt_next"])
+            # the scalar pull alone does not prove the donated state
+            # landed; charge the field compute to "flow", not to the
+            # next phase that happens to touch it
+            tm.fence("flow", self.state)
         for k, s in enumerate(self.shapes):
             if s.free:
                 s.u, s.v, s.omega = uvw_np[k]

@@ -240,23 +240,36 @@ class UniformGrid:
         """deltap pressure solve + velocity correction
         (main.cpp:7007-7187): b = (h/2dt)[div u* - chi div u_def] -
         lap(pold); p = dp + pold (both mean-free); u += -dt/(2h) grad p.
-        Returns (vel, pres, solver_result). ``chi=None`` (obstacle-free
-        callers) drops the identically-zero chi*div(u_def) term."""
+        Returns (vel, pres, solver_result, div_linf). ``chi=None``
+        (obstacle-free callers) drops the identically-zero
+        chi*div(u_def) term. ``div_linf`` is max |∇·(u* − χ u_def)| of
+        the pre-projection velocity — the divergence field the step
+        already forms as the Poisson RHS, rescaled to physical units
+        (zero extra field passes; the telemetry watchdog's second
+        invariant, resilience.PhysicsWatchdog)."""
         h = self.h
         ih2 = 1.0 / (h * h)
         if chi is None:
             b = (0.5 * h / dt) * divergence_freeslip(vel, self.spmd_safe)
         else:
             b = divergence_rhs_fused(vel, udef, chi, h, dt, self.spmd_safe)
+        # |b| = (h/2dt) * |undivided div|; physical div = undivided/(2h)
+        div_linf = jnp.max(jnp.abs(b)) * (dt / (h * h))
         b = b - laplacian5_neumann(pres_old, self.spmd_safe)
         res = self.pressure_solve(b, exact=exact_poisson)
         dp = res.x - jnp.mean(res.x)
         pres = dp + pres_old - jnp.mean(pres_old)
         dv = pressure_gradient_update_fused(pres, h, dt, self.spmd_safe)
-        return vel + dv * ih2, pres, res
+        return vel + dv * ih2, pres, res, div_linf
 
-    def step_diag(self, vel, pres, res) -> dict:
+    def step_diag(self, vel, pres, res, div_linf=None) -> dict:
         umax = jnp.max(jnp.abs(vel))
+        # kinetic energy: the telemetry watchdog's first invariant —
+        # one extra fused reduction over a field the diag pass reads
+        # anyway (umax); accumulated in sum_dtype like the Krylov dots
+        vv = vel.astype(self.sum_dtype) if self.sum_dtype is not None \
+            else vel
+        energy = 0.5 * self.h * self.h * jnp.sum(vv * vv)
         return {
             "poisson_iters": res.iters,
             "poisson_residual": res.residual,
@@ -271,6 +284,10 @@ class UniformGrid:
             "finite": jnp.all(jnp.isfinite(vel))
             & jnp.all(jnp.isfinite(pres)),
             "umax": umax,
+            # physics invariants for the watchdog + metrics stream,
+            # riding the same batched diag pull (PR 3)
+            "energy": energy,
+            "div_linf": div_linf,
             # next step's dt rides the same device call (no separate
             # dt round trip, r1 weak #10)
             "dt_next": self.dt_from_umax(umax),
@@ -296,12 +313,12 @@ class UniformGrid:
             alpha = jnp.where(state.chi > 0.5, 1.0 / (1.0 + cfg.lam * dt), 1.0)
             vel = alpha * vel + (1.0 - alpha) * state.us
 
-        vel, pres, res = self.project(
+        vel, pres, res, div_linf = self.project(
             vel, state.pres,
             state.chi if obstacle_terms else None,
             state.udef if obstacle_terms else None, dt, exact_poisson)
         return state._replace(vel=vel, pres=pres), \
-            self.step_diag(vel, pres, res)
+            self.step_diag(vel, pres, res, div_linf)
 
     def vorticity_field(self, vel: jnp.ndarray) -> jnp.ndarray:
         return vorticity(pad_vector(vel, 1), 1, self.h)

@@ -6,6 +6,7 @@ import os
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from cup2d_tpu.amr import AMRSim
 from cup2d_tpu.config import SimConfig
@@ -102,6 +103,9 @@ def test_chi_tagging_refines_to_finest():
     assert abs(per - 2 * np.pi * 0.08) < 0.15 * 2 * np.pi * 0.08, per
 
 
+@pytest.mark.slow   # ~32 s; checkpoint bit-exactness stays tier-1 via
+#                     test_io (uniform roundtrip + the AMR restore-cache
+#                     trio) and test_resilience rung 3
 def test_amr_checkpoint_roundtrip(tmp_path):
     """Forest checkpoint restores topology + fields bit-exactly and the
     resumed trajectory matches an uninterrupted run."""
@@ -158,6 +162,10 @@ def test_amr_checkpoint_roundtrip(tmp_path):
         sim.time, sim3.time, sim4.time)
 
 
+@pytest.mark.slow   # ~206 s, the tier-1 dominator (PR-3 satellite):
+#                     the fast end-to-end CLI smoke retained in tier-1
+#                     is tests/test_io.py::test_cli_driver_smoke (+ the
+#                     in-process telemetry CLI test)
 def test_cli_amr_smoke(tmp_path):
     """`python -m cup2d_tpu` with run.sh-style flags (no -level) runs the
     ADAPTIVE path end-to-end: dumps, forces.csv, checkpoint, restart."""
@@ -181,6 +189,7 @@ def test_cli_amr_smoke(tmp_path):
     assert main(argv2) == 0
 
 
+@pytest.mark.slow   # ~102 s CLI smoke (see test_cli_amr_smoke note)
 def test_cli_uniform_smoke(tmp_path):
     """`-level N` forces the single-resolution uniform path through the
     same CLI (dump + forces + exit 0)."""

@@ -32,6 +32,9 @@ SANCTIONED_SITES = {
     # read once from ShardedAMRSim.__init__, stored as self._exchange
     ("parallel/forest_mesh.py", "_exchange_mode"):
         {"CUP2D_SHARD_EXCHANGE"},
+    # windowed device tracing: latched once by the CLI before the run
+    # loop (a mid-run mutation must not re-arm a finished window)
+    ("profiling.py", "TraceWindow.from_env"): {"CUP2D_TRACE"},
     # enable-once process knobs (cache paths, not numerics gates)
     ("cache.py", "enable_compilation_cache"): {"CUP2D_CACHE"},
     ("native/__init__.py", "_load"): {"CUP2D_NATIVE_CACHE"},

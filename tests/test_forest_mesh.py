@@ -69,6 +69,9 @@ def test_sharded_forest_obstacle_matches_single_device():
     assert len(sh._ordered_state()["vel"].sharding.device_set) == 8
 
 
+@pytest.mark.slow   # ~36 s; the OBSTACLE sharded==single equality above
+#                     covers the superset step (raster + collisions +
+#                     forces on the mesh) and stays tier-1
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
 @pytest.mark.parametrize("ndev", [8, 4])
 def test_sharded_forest_matches_single_device(ndev):

@@ -18,6 +18,8 @@ from validation.golden_fish_contact import GOLDEN_PATH, N_STEPS, \
 
 @pytest.mark.skipif(not os.path.exists(GOLDEN_PATH),
                     reason="golden_fish_contact.json not generated")
+@pytest.mark.slow   # ~76 s; the canonical two-fish golden and the
+#                     collision golden keep trajectory pinning in tier-1
 def test_golden_fish_contact_trajectory():
     with open(GOLDEN_PATH) as f:
         want = json.load(f)

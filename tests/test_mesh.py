@@ -61,6 +61,9 @@ def test_sharded_matches_single_device_trajectory():
     assert len(sh.state.vel.sharding.device_set) == 8
 
 
+@pytest.mark.slow   # ~31 s; sharded Krylov coverage stays tier-1 via
+#                     the sharded trajectory test above + the forest
+#                     ShardPoissonOp equality (test_forest_mesh)
 def test_sharded_poisson_iterates():
     """The Krylov loop itself must run sharded (collectives inside
     lax.while_loop), not just the stencils."""

@@ -156,6 +156,9 @@ def test_guard_unfaulted_bit_identical_uniform(tmp_path, monkeypatch):
     assert traces_b == traces_a
 
 
+@pytest.mark.slow   # ~43 s; the zero-overhead contract stays tier-1 on
+#                     the uniform path (above + the telemetry-stack
+#                     variant in test_telemetry.py)
 def test_guard_unfaulted_bit_identical_amr(tmp_path, monkeypatch):
     from cup2d_tpu.amr import AMRSim
 
@@ -212,7 +215,12 @@ def _drive_to(sim, tend, stepper):
         stepper(min(dt, tend - sim.time + 1e-15))
 
 
-@pytest.mark.parametrize("directive", ["nan_vel@3", "inf_vel@3"])
+@pytest.mark.parametrize("directive", [
+    "nan_vel@3",
+    # ~29 s dup of the same rung: Inf-vs-NaN differs only inside
+    # health_verdict, unit-covered by test_health_verdict_policy
+    pytest.param("inf_vel@3", marks=pytest.mark.slow),
+])
 def test_rung1_poison_recovers_via_rewind(tmp_path, directive):
     tend = 0.3
     ref = _sim()
@@ -307,6 +315,9 @@ def test_rung4_abort_leaves_postmortem(tmp_path):
     assert fresh.step_count == sim.step_count
 
 
+@pytest.mark.slow   # ~30 s (deforming-fish init dominates); the
+#                     ring-seed-after-blend ordering it pins is also
+#                     load-bearing for every tier-1 rung test above
 def test_first_step_failure_keeps_chi_blend(tmp_path):
     """The ring seed must be captured AFTER the lazy chi-blend
     initialization: restoring a pre-initialize snapshot marks the sim
@@ -334,6 +345,8 @@ def test_first_step_failure_keeps_chi_blend(tmp_path):
                        np.asarray(ref.state.vel), atol=1e-14)
 
 
+@pytest.mark.slow   # ~19 s; -noSupervise abort semantics stay tier-1
+#                     end-to-end via test_cli_nan_abort_via_guard
 def test_verdict_only_mode_aborts_first_failure(tmp_path):
     sim = _sim()
     pm = str(tmp_path / "postmortem")
@@ -422,6 +435,10 @@ def _run_cli(outdir, extra, fault=None):
                           text=True)
 
 
+@pytest.mark.slow   # ~30 s, three CLI subprocesses (the smoke class
+#                     the PR-3 satellite moves out of tier-1);
+#                     test_cli_nan_abort_via_guard keeps a supervised
+#                     CLI subprocess in tier-1
 def test_sigterm_checkpoints_and_restart_resumes(tmp_path):
     out1 = tmp_path / "run1"
     out2 = tmp_path / "run2"

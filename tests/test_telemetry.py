@@ -1,0 +1,418 @@
+"""Run-telemetry subsystem tests (profiling.py + the PR-3 resilience
+additions): the frozen metrics schema, the zero-extra-sync contract
+(metrics-on bit-identical to metrics-off with EQUAL device_get counts —
+the PR-2 trace-count harness extended), the physics-invariant watchdog
+against injected wrong-but-finite corruption, the steady-state
+recompile/transfer-count guard, phase-timer fencing, and the windowed
+trace driver."""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cup2d_tpu.config import SimConfig
+from cup2d_tpu.faults import FaultPlan
+from cup2d_tpu.models import DiskShape
+from cup2d_tpu.profiling import (METRICS_KEYS, HostCounters,
+                                 MetricsRecorder, NULL_TIMERS,
+                                 PhaseTimers, TraceWindow, load_metrics,
+                                 summarize_metrics)
+from cup2d_tpu.resilience import (EventLog, PhysicsWatchdog, StepGuard)
+from cup2d_tpu.sim import Simulation
+
+
+def _cfg(**kw):
+    base = dict(bpdx=1, bpdy=1, level_max=1, level_start=0, extent=1.0,
+                nu=1e-3, cfl=0.4, lam=1e6, dtype="float64",
+                max_poisson_iterations=100)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _sim():
+    disk = DiskShape(0.1, 0.4, 0.5, prescribed=(0.2, 0.0))
+    return Simulation(_cfg(), shapes=[disk], level=3)
+
+
+def _amr_sim():
+    from cup2d_tpu.amr import AMRSim
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=3, level_start=1,
+                    extent=1.0, dtype="float64", nu=1e-3, lam=1e5,
+                    rtol=0.5, ctol=0.05, max_poisson_iterations=40,
+                    poisson_tol=1e-4, poisson_tol_rel=1e-3)
+    sim = AMRSim(cfg, shapes=[DiskShape(0.08, 0.4, 0.5,
+                                        prescribed=(0.2, 0.0))])
+    sim.compute_forces_every = 0
+    return sim
+
+
+# ---------------------------------------------------------------------------
+# schema stability (golden key set): every producer emits the SAME keys
+# ---------------------------------------------------------------------------
+
+def test_metrics_schema_stable_uniform_amr_bench():
+    gold = set(METRICS_KEYS)
+
+    # uniform driver path
+    sim = _sim()
+    rec = MetricsRecorder()
+    rec.prime(sim)
+    r = rec.record(sim, sim.step_once())
+    assert set(r) == gold
+    # the dt baseline was primed: the first record carries a real dt
+    assert r["dt"] is not None and r["dt"] > 0
+    assert r["energy"] > 0 and r["div_linf"] >= 0
+    assert r["n_blocks"] is None        # uniform: AMR fields null
+
+    # forest driver path
+    asim = _amr_sim()
+    asim.initialize()
+    arec = MetricsRecorder()
+    arec.prime(asim)
+    ar = arec.record(asim, asim.step_once())
+    assert set(ar) == gold
+    assert ar["n_blocks"] > 0
+    assert sum(ar["blocks_per_level"].values()) == ar["n_blocks"]
+    assert ar["energy"] > 0
+
+    # bench path (record_step without a sim): same key set, so a
+    # BENCH_*.json telemetry block and a run's metrics.jsonl are one
+    # schema
+    host_diag = {k: r[k] for k in ("umax", "dt_next", "poisson_iters",
+                                   "poisson_residual",
+                                   "poisson_converged",
+                                   "poisson_stalled", "energy",
+                                   "div_linf")}
+    br = MetricsRecorder().record_step(step=1, t=0.1, dt=0.1,
+                                       diag=host_diag, wall_ms=2.0)
+    assert set(br) == gold
+
+
+def test_metrics_jsonl_stream_and_summary(tmp_path):
+    sink = EventLog(str(tmp_path / "metrics.jsonl"))
+    sim = _sim()
+    rec = MetricsRecorder(sink=sink)
+    rec.prime(sim)
+    for _ in range(3):
+        rec.record(sim, sim.step_once(), wall_ms=1.5)
+    sink.close()
+    recs = load_metrics(str(tmp_path / "metrics.jsonl"))
+    ms = [r for r in recs if r.get("event") == "metrics"]
+    assert [r["step"] for r in ms] == [1, 2, 3]
+    # the stream carries the schema keys plus the EventLog envelope
+    assert set(ms[0]) - {"event", "wall"} == set(METRICS_KEYS)
+    s = summarize_metrics(recs)
+    assert s["steps"] == 3
+    assert s["t_final"] == pytest.approx(sim.time)
+    assert s["poisson_iters"]["max"] >= s["poisson_iters"]["mean"] > 0
+    assert s["energy_last"] > 0
+    assert s["wall_ms"]["mean"] == pytest.approx(1.5)
+
+
+# ---------------------------------------------------------------------------
+# physics-invariant watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_policy_unit():
+    wd = PhysicsWatchdog(window=3, energy_factor=4.0, div_factor=50.0)
+    # warm-up: no verdicts until the window is full of good steps
+    assert wd.check({"energy": 100.0, "div_linf": 100.0}) is None
+    for _ in range(3):
+        wd.observe({"umax": 2.0, "energy": 1.0, "div_linf": 0.1})
+    assert wd.check({"umax": 2.1, "energy": 1.2,
+                     "div_linf": 0.12}) is None
+    # umax jump flags first (it is the earliest-armed invariant)
+    assert wd.check({"umax": 20.0, "energy": 1.0}) == "invariant_umax"
+    # energy jump and collapse both flag
+    assert wd.check({"energy": 5.0}) == "invariant_energy"
+    assert wd.check({"energy": 0.2}) == "invariant_energy"
+    # divergence blow-up flags; inside the bound does not
+    assert wd.check({"energy": 1.0, "div_linf": 6.0}) \
+        == "invariant_divergence"
+    assert wd.check({"energy": 1.0, "div_linf": 4.0}) is None
+    # a flagged step must never enter its own baseline: the window
+    # still describes the good history
+    assert wd.check({"energy": 1.1}) is None
+    wd.reset()
+    assert wd.check({"energy": 50.0}) is None   # cleared = warm-up again
+
+
+def test_watchdog_unsettled_signal_stays_dormant():
+    """Relative drift bounds on an unsettled invariant are meaningless
+    (spin-up from rest legitimately multiplies the energy per step —
+    a dt/2 retry measured 8x the window max on the fish case), so an
+    invariant whose window is not settled must NOT arm: a full window
+    of exponential growth never fires, while the settled umax band
+    still catches the same corruption."""
+    wd = PhysicsWatchdog(window=4, energy_settle=2.0)
+    for k in range(4):
+        wd.observe({"energy": 10.0 ** k, "umax": 1.0})
+    # energy window spans 1..1000 (ratio 1000 > settle 2): dormant even
+    # for a 100x jump...
+    assert wd.check({"energy": 1e5}) is None
+    # ...but the settled umax band catches the same corrupted step
+    assert wd.check({"energy": 1e5, "umax": 10.0}) == "invariant_umax"
+
+
+def test_watchdog_catches_injected_finite_corruption(tmp_path):
+    """faults.scale_vel multiplies the velocity x10 — finite
+    everywhere, invisible to the isfinite verdict — and the watchdog
+    flags it within its window; the ladder's rewind-retry recovers."""
+    sim = _sim()
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    guard = StepGuard(sim, watchdog=PhysicsWatchdog(window=4),
+                      faults=FaultPlan("scale_vel@6"), event_log=log)
+    for _ in range(8):
+        guard.step()
+    with open(tmp_path / "events.jsonl") as f:
+        evs = [json.loads(line) for line in f if line.strip()]
+    recov = [e for e in evs if e.get("event") == "recovery"]
+    assert [e["action"] for e in recov] == ["retry"]
+    assert recov[0]["step"] == 6
+    assert recov[0]["verdict"].startswith("invariant_")
+    assert sim.step_count == 8
+    assert np.all(np.isfinite(np.asarray(sim.state.vel)))
+
+
+def test_fault_plan_scale_vel_parse():
+    p = FaultPlan("scale_vel@4*2")
+    assert p.vel_scale[4] == [10.0, 2]
+    assert bool(p)
+    with pytest.raises(ValueError):
+        FaultPlan("scale_vel")             # step is required
+
+
+# ---------------------------------------------------------------------------
+# zero-extra-sync contract: metrics-on == metrics-off, bit for bit,
+# with EQUAL device_get counts (the PR-2 harness extended to the full
+# telemetry stack: recorder + counters + watchdog)
+# ---------------------------------------------------------------------------
+
+def test_metrics_on_bit_identical_equal_pulls(tmp_path, monkeypatch):
+    traces = {"n": 0}
+    orig_impl = Simulation._flow_step_impl
+
+    def counted_impl(self, *a, **k):
+        traces["n"] += 1
+        return orig_impl(self, *a, **k)
+
+    monkeypatch.setattr(Simulation, "_flow_step_impl", counted_impl)
+
+    def run(telemetry):
+        sim = _sim()
+        counters = guard = rec = None
+        if telemetry:
+            counters = HostCounters().install()
+            sink = EventLog(str(tmp_path / "metrics.jsonl"))
+            rec = MetricsRecorder(sink=sink, counters=counters)
+            rec.prime(sim)
+            guard = StepGuard(sim, watchdog=PhysicsWatchdog())
+        pulls = {"n": 0}
+        real_get = jax.device_get
+
+        def counting_get(x):
+            pulls["n"] += 1
+            return real_get(x)
+
+        t0 = traces["n"]
+        try:
+            with monkeypatch.context() as m:
+                m.setattr(jax, "device_get", counting_get)
+                for _ in range(5):
+                    if telemetry:
+                        rec.record(sim, guard.step())
+                    else:
+                        sim.step_once()
+        finally:
+            if counters is not None:
+                counters.uninstall()
+        return (np.asarray(sim.state.vel), np.asarray(sim.state.pres),
+                sim.time, pulls["n"], traces["n"] - t0)
+
+    va, pa, ta, pulls_a, traces_a = run(False)
+    vb, pb, tb, pulls_b, traces_b = run(True)
+    assert np.array_equal(va, vb)
+    assert np.array_equal(pa, pb)
+    assert ta == tb
+    # the whole telemetry stack rides the step's existing batched pull:
+    # no extra device_get, no extra trace of the step function
+    assert pulls_b == pulls_a
+    assert traces_b == traces_a
+
+
+def test_metrics_no_second_pull_on_device_diag(monkeypatch):
+    """The obstacle-free AMR step deliberately keeps its diag scalars
+    ON DEVICE; the guard's verdict pulls them once (batched), and the
+    guard must hand those host values to the recorder — metrics-on must
+    not re-pull what the verdict already fetched (code review PR 3)."""
+    from cup2d_tpu.amr import AMRSim
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=2, level_start=1,
+                    extent=1.0, dtype="float64", nu=1e-3,
+                    max_poisson_iterations=40)
+    def run(metrics):
+        rng = np.random.default_rng(0)
+        sim = AMRSim(cfg, shapes=[])
+        f = sim.forest
+        f.fields["vel"] = f.fields["vel"] + jnp.asarray(
+            0.1 * rng.standard_normal(f.fields["vel"].shape))
+        guard = StepGuard(sim)
+        rec = MetricsRecorder() if metrics else None
+        pulls = {"n": 0}
+        real_get = jax.device_get
+
+        def counting_get(x):
+            pulls["n"] += 1
+            return real_get(x)
+
+        with monkeypatch.context() as m:
+            m.setattr(jax, "device_get", counting_get)
+            for _ in range(3):
+                diag = guard.step()
+                if rec is not None:
+                    rec.record(sim, diag)
+        return pulls["n"]
+
+    assert run(True) == run(False)
+
+
+# ---------------------------------------------------------------------------
+# CI guard: steady-state steps compile NOTHING and pull a bounded count
+# ---------------------------------------------------------------------------
+
+def test_steady_state_zero_recompiles_bounded_transfers():
+    sim = _sim()
+    sim.compute_forces_every = 0
+    for _ in range(3):
+        sim.step_once()                    # warm every executable
+    c = HostCounters().install()
+    try:
+        n = 4
+        for _ in range(n):
+            sim.step_once()
+    finally:
+        c.uninstall()
+    # a steady-state step must be a pure cache hit: one XLA compile
+    # here means a shape/static-arg leak (the r1 per-count retrace bug
+    # class) — and it would cost minutes per occurrence through the
+    # remote-compile tunnel
+    assert c.jit_compiles == 0
+    # the hot-path pull discipline: exactly TWO batched device_gets per
+    # shaped uniform step (the rasterize scalar sync + the step's one
+    # diag/uvw pull); anything above means a new per-step round trip
+    # leaked in (~100 ms each through the TPU tunnel)
+    assert c.device_gets == 2 * n
+
+
+# ---------------------------------------------------------------------------
+# phase timers: fence exists, attributes, and the report covers phases
+# ---------------------------------------------------------------------------
+
+def test_phase_timers_fence_and_report():
+    sim = _sim()
+    sim.timers = PhaseTimers()          # pre-PR3 this crashed: only
+    sim.step_once()                     # _NullTimers had fence()
+    rep = sim.timers.report()
+    for phase in ("rasterize", "flow"):
+        assert phase in rep and rep[phase]["count"] == 1
+    # fence passes arrays through unchanged (same contract as
+    # NULL_TIMERS) and accepts pytrees
+    x = jnp.ones(3)
+    out = sim.timers.fence("x", x, {"a": x})
+    assert out[0] is x
+    assert NULL_TIMERS.fence("x", x)[0] is x
+
+
+@pytest.mark.slow   # ~11-25 s (fresh AMR init); the fence mechanism
+#                     itself is tier-1-covered by the uniform test above
+def test_phase_timers_fence_amr():
+    sim = _amr_sim()
+    sim.timers = PhaseTimers()
+    sim.initialize()
+    sim.adapt()
+    sim.step_once()
+    rep = sim.timers.report()
+    assert "tables" in rep and "flow" in rep
+
+
+# ---------------------------------------------------------------------------
+# windowed device tracing
+# ---------------------------------------------------------------------------
+
+def test_trace_window_parse(monkeypatch, tmp_path):
+    monkeypatch.delenv("CUP2D_TRACE", raising=False)
+    assert TraceWindow.from_env() is None
+    monkeypatch.setenv("CUP2D_TRACE", f"2:4:{tmp_path}/tr")
+    tw = TraceWindow.from_env()
+    assert (tw.start, tw.stop, tw.logdir) == (2, 4, f"{tmp_path}/tr")
+    monkeypatch.setenv("CUP2D_TRACE", "7:9")
+    assert TraceWindow.from_env().logdir == "trace"
+    for bad in ("5", "4:2", "a:b", "-1:3"):
+        monkeypatch.setenv("CUP2D_TRACE", bad)
+        with pytest.raises(ValueError):
+            TraceWindow.from_env()
+
+
+def test_trace_window_wraps_exact_steps(tmp_path):
+    logdir = str(tmp_path / "trace")
+    tw = TraceWindow(1, 3, logdir)
+    f = jax.jit(lambda x: x * 2.0)
+    x = jnp.ones(16)
+    seen = []
+    for step in range(5):
+        tw.maybe_start(step)
+        seen.append(tw.active)
+        x = f(x)
+        tw.maybe_stop(step + 1)
+    tw.close()
+    # active exactly while stepping steps 1 and 2
+    assert seen == [False, True, True, False, False]
+    assert tw.done and not tw.active
+    # the trace actually materialized (TensorBoard xplane dump)
+    assert glob.glob(os.path.join(logdir, "plugins", "profile",
+                                  "*", "*")), \
+        "trace window left no profile dump"
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end (in-process): metrics stream + post --metrics report
+# ---------------------------------------------------------------------------
+
+def test_cli_metrics_stream_and_post_report(tmp_path, monkeypatch,
+                                            capsys):
+    from cup2d_tpu import post
+    from cup2d_tpu.__main__ import main
+
+    monkeypatch.delenv("CUP2D_FAULTS", raising=False)
+    monkeypatch.delenv("CUP2D_TRACE", raising=False)
+    out = tmp_path / "run"
+    rc = main([
+        "-bpdx", "1", "-bpdy", "1", "-levelMax", "1", "-levelStart", "0",
+        "-Rtol", "2", "-Ctol", "1", "-extent", "1", "-CFL", "0.4",
+        "-tend", "1", "-lambda", "1e6", "-nu", "0.001",
+        "-poissonTol", "1e-3", "-poissonTolRel", "1e-2",
+        "-maxPoissonRestarts", "0", "-maxPoissonIterations", "100",
+        "-AdaptSteps", "20", "-tdump", "0", "-level", "3",
+        "-dtype", "float64",
+        "-shapes", "angle=0 L=0.25 xpos=0.5 ypos=0.5",
+        "-output", str(out), "-maxSteps", "3",
+    ])
+    assert rc == 0
+    recs = load_metrics(str(out / "metrics.jsonl"))
+    ms = [r for r in recs if r.get("event") == "metrics"]
+    assert [r["step"] for r in ms] == [1, 2, 3]
+    assert set(ms[0]) - {"event", "wall"} == set(METRICS_KEYS)
+    # per-step counters came through the CLI's HostCounters install
+    assert all(r["device_gets"] is not None for r in ms)
+    assert ms[-1]["jit_compiles"] == 0     # steady state by step 3
+    capsys.readouterr()
+    rc = post.main(["--metrics", str(out / "metrics.jsonl")])
+    assert rc == 0
+    summary = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["steps"] == 3
+    assert summary["source"].endswith("metrics.jsonl")
