@@ -392,8 +392,29 @@ def run_adaptive(n_warm_steps: int = 40, chain: int = 15):
     }
 
 
+def _init_platform() -> str:
+    """Initialize an available backend. On boxes without the configured
+    accelerator, jax's first device probe dies with RuntimeError
+    ('Unable to initialize backend ...') — which used to surface as an
+    rc=1 stack-trace tail in BENCH_*.json (BENCH_r04/r05). Fall back to
+    whatever platform initializes (CPU always does) and report it in
+    the JSON instead: a bench that says 'platform: cpu' is honest; a
+    crashed bench measures nothing."""
+    try:
+        return jax.devices()[0].platform
+    except Exception as e:   # noqa: BLE001 — jax 0.4.37 raises a bare
+        # AssertionError (not RuntimeError) when JAX_PLATFORMS names a
+        # registered platform with no usable device; the bench must
+        # fall back either way
+        print(f"bench: {type(e).__name__}: {e}; falling back to cpu",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0].platform
+
+
 def main():
     from cup2d_tpu.cache import enable_compilation_cache
+    platform = _init_platform()
     enable_compilation_cache()
     size = int(os.environ.get("BENCH_SIZE", "8192"))
     n_warmup = int(os.environ.get("BENCH_WARMUP", "3"))
@@ -456,6 +477,7 @@ def main():
         "unit": "cells*steps/s",
         "vs_baseline": vs_baseline,
         "backend": jax.default_backend(),
+        "platform": platform,
         "dtype": "float32",
         ("uniform_8192_device_cells_steps_per_sec" if have_device
          else "uniform_8192_cells_steps_per_sec_wall_fallback"): uni_value,

@@ -20,9 +20,16 @@ The run loop is SUPERVISED (resilience.py): every step's health verdict
 rides the diagnostics the step already pulls, a bad step walks the
 rewind/escalate/disk-restore/abort ladder, SIGTERM checkpoints at the
 next step boundary and exits 0, and every recovery lands in
-``<output>/events.jsonl``. Knobs: ``-noSupervise`` (verdict-only: first
-bad step aborts — still with a post-mortem checkpoint, unlike the old
-inline NaN check), ``-guardRing K`` (good-state ring depth, default 2),
+``<output>/events.jsonl``. Since PR 4 the supervision tax is off the
+hot loop: the good-state ring is DEVICE-RESIDENT (HBM copies, no
+per-step D2H gather), ``-snapEvery N`` snapshots every N good steps
+and replays bit-exactly from the last snapshot on a bad verdict, and
+the verdict itself is ONE-STEP-LAGGED on the device-diag paths (step
+N+1 dispatches before step N's scalars are pulled — detection latency
+1 step, zero blocking per-step syncs; ``-noLag`` restores the eager
+verdict). Knobs: ``-noSupervise`` (verdict-only: first bad step aborts
+— still with a post-mortem checkpoint, unlike the old inline NaN
+check), ``-guardRing K`` (confirmed-snapshot ring depth, default 1),
 ``-eventLog PATH``. Fault drills: the ``CUP2D_FAULTS`` env var
 (faults.py) injects NaNs, wrong-but-finite field corruption, solver
 give-ups, mid-save crashes and SIGTERMs on schedule.
@@ -111,21 +118,6 @@ def main(argv=None) -> int:
             sim.sync_fields()
             dump_forest(path, sim.time, sim.forest)
 
-    # telemetry: on unless -noMetrics; the record rides the step's one
-    # existing batched diag pull, so the only per-step cost is host
-    # bookkeeping + a JSONL line on process 0
-    metrics_log = None
-    recorder = None
-    counters = None
-    if not p.has("noMetrics"):
-        metrics_path = p("metricsLog").asString() if p.has("metricsLog") \
-            else os.path.join(outdir, "metrics.jsonl")
-        metrics_log = EventLog(metrics_path)
-        counters = HostCounters().install()
-        recorder = MetricsRecorder(sink=metrics_log, counters=counters,
-                                   timers=sim.timers)
-        recorder.prime(sim)
-
     ckpt_path = os.path.join(outdir, "checkpoint")
     guard = StepGuard(
         sim,
@@ -136,7 +128,46 @@ def main(argv=None) -> int:
         faults=plan,
         recover=not p.has("noSupervise"),
         watchdog=None if p.has("noWatchdog") else PhysicsWatchdog(),
+        snap_every=p("snapEvery").asInt() if p.has("snapEvery") else 1,
+        lag=not p.has("noLag"),
     )
+
+    # telemetry: on unless -noMetrics; the record rides the step's one
+    # existing batched diag pull — under the lagged verdict the record
+    # for step N is emitted when its verdict lands (during step N+1's
+    # call, or at the final drain), labeled with the step's own
+    # step/t/dt from the guard's record. The CALL-scoped host metrics
+    # (wall_ms, jit_compiles/device_gets deltas, phase_ms) therefore
+    # describe the call that resolved N — i.e. N+1's dispatch plus N's
+    # lagged pull — a one-call skew that is CONSISTENT across those
+    # fields (a compile spike and its wall cost land on the same row).
+    metrics_log = None
+    recorder = None
+    counters = None
+    if not p.has("noMetrics"):
+        metrics_path = p("metricsLog").asString() if p.has("metricsLog") \
+            else os.path.join(outdir, "metrics.jsonl")
+        metrics_log = EventLog(metrics_path)
+        counters = HostCounters().install()
+        recorder = MetricsRecorder(sink=metrics_log, counters=counters,
+                                   timers=sim.timers, guard=guard)
+        recorder.prime(sim)
+
+    def record(rec, wall_ms=None):
+        if rec is not None and recorder is not None:
+            recorder.record_step(step=rec["step"], t=rec["t"],
+                                 dt=rec["dt"], diag=rec, sim=sim,
+                                 wall_ms=wall_ms)
+
+    def drain():
+        # settle every in-flight verdict (before dumps, regrids,
+        # checkpoints, preemption saves and at loop exit — all of them
+        # read state + clock, and a checkpoint of an unverdicted step
+        # would persist a possibly-bad state); recovery may rewind the
+        # clock, so callers re-check the loop condition after
+        for rec in guard.drain():
+            record(rec)
+
     # SIGTERM = preemption notice: finish the step in flight, write the
     # restart point, exit 0 (the grace window buys a checkpoint, not a
     # corpse). Installed around the loop only — library users keep
@@ -146,8 +177,25 @@ def main(argv=None) -> int:
     rc = 0
     try:
         next_dump = sim.time if cfg.dump_time > 0 else float("inf")
-        while sim.time < cfg.end_time and sim.step_count < max_steps:
+        while True:
+            if not (sim.time < cfg.end_time
+                    and sim.step_count < max_steps):
+                # loop end — or a lagged NaN clock; the drain settles
+                # pending verdicts (recovery rewinds a poisoned clock),
+                # then the condition is re-checked. Note the lag-1
+                # semantics: the condition reads the settled clock (one
+                # step stale on the device-diag paths), so the run may
+                # dispatch-and-commit ONE step more than a -noLag run
+                # of the same case before stopping — the reference loop
+                # itself overshoots tend by up to one dt, and the
+                # per-step states remain bit-identical; only the
+                # stopping point shifts by <= 1 step.
+                if guard.pending:
+                    drain()
+                    continue
+                break
             if stop.triggered:
+                drain()
                 save_checkpoint(ckpt_path, sim)
                 log.emit(event="sigterm_checkpoint", step=sim.step_count,
                          sim_time=sim.time, path=ckpt_path,
@@ -157,29 +205,37 @@ def main(argv=None) -> int:
                       "cleanly", file=sys.stderr)
                 return 0
             if sim.step_count % 5 == 0:
+                # the clock is settled-through-verdict: one step stale
+                # on the lagged paths (cosmetic here)
                 print(f"cup2d_tpu: {sim.step_count:08d} t={sim.time:.6f}",
                       file=sys.stderr)
             if cfg.dump_time > 0 and sim.time >= next_dump:
                 # catch the schedule up even when dt > tdump (the
                 # reference falls permanently behind there,
-                # main.cpp:6597-6602)
-                while next_dump <= sim.time:
-                    next_dump += cfg.dump_time
-                dump(os.path.join(outdir, f"vel.{sim.step_count:08d}"))
+                # main.cpp:6597-6602); the lagged clock can trigger
+                # this one step late — the dump itself must see a
+                # settled, verdicted state, and the drain's recovery
+                # may REWIND the clock below the schedule (disk-restore
+                # rung), in which case this dump is not due after all
+                drain()
+                if sim.time >= next_dump:
+                    while next_dump <= sim.time:
+                        next_dump += cfg.dump_time
+                    dump(os.path.join(outdir,
+                                      f"vel.{sim.step_count:08d}"))
             if not uniform and (sim.step_count <= 10
                                 or sim.step_count % cfg.adapt_steps == 0):
+                drain()   # never regrid an unverdicted state
                 sim.adapt()
             if tracer is not None:
                 tracer.maybe_start(sim.step_count)
             t_step = time.perf_counter()
-            diag = guard.step()
+            rec = guard.step()
             if tracer is not None:
                 tracer.maybe_stop(sim.step_count)
-            if recorder is not None:
-                recorder.record(
-                    sim, diag,
-                    wall_ms=1e3 * (time.perf_counter() - t_step))
+            record(rec, wall_ms=1e3 * (time.perf_counter() - t_step))
             if ckpt_every and sim.step_count % ckpt_every == 0:
+                drain()
                 save_checkpoint(ckpt_path, sim)
     except ResilienceAbort as e:
         # the guard already wrote the post-mortem checkpoint, emitted

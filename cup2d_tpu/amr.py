@@ -235,6 +235,17 @@ class AMRSim(ShapeHostMixin):
         # StepGuard's escalation rung forces the exact (tol-0 + coarse
         # correction) Poisson solve on a retried step (resilience.py)
         self._force_exact = False
+        # lagged-verdict mode (resilience.StepGuard, lag=True): the
+        # obstacle-free branch derives dt on DEVICE from the cached
+        # end-state umax, keeps the diag (incl. the dt used) on device
+        # and leaves clock settlement + the iters-trigger drain to the
+        # guard's lagged pull — zero blocking host syncs per steady
+        # step. Side effect documented there: the two-level iters>15
+        # trigger sees the count one step later than the eager path
+        # (it is sticky hysteresis; one extra block-Jacobi-only solve
+        # before engagement). The shaped branch ignores the flag (its
+        # uvw/CoM pull feeds the host kinematics).
+        self.async_diag = False
         self._raster_jit = jax.jit(self._rasterize_impl)
         self._vorticity_jit = jax.jit(self._vorticity_impl)
         self._tags_jit = jax.jit(self._tags_impl)
@@ -419,7 +430,26 @@ class AMRSim(ShapeHostMixin):
 
         The pytree is a dict keyed by active level, so the jit
         executable is keyed on the LEVEL SET (changes rarely, and only
-        at regrids) instead of per-cell map contents."""
+        at regrids) instead of per-cell map contents.
+
+        Levels FINER than the coarse level (l > c, the O(4^l) cells)
+        are CROPPED to one shared active-tile bounding-box window
+        (``levf`` + the dynamic ``crop`` origin): a deep refinement
+        spot no longer paints a full-domain image at its own
+        resolution per M application (the former ROADMAP cliff). The
+        window is the union of the fine levels' tile bboxes in
+        coarse-cell units, padded by 2 coarse cells (the bilinear
+        up-ladder's influence radius is < 2, so every ACTIVE cell's
+        dependence set stays inside the window and the cropped
+        transfers are BIT-IDENTICAL to the full-domain form —
+        tests/test_amr.py::test_two_level_crop_matches_full_domain)
+        and snapped to an alignment grid that keeps every fine level's
+        window tile-aligned. The window ORIGIN crosses the jit
+        boundary as an int32 array (lax.dynamic_slice), so a regrid
+        that moves the active spot without resizing the window reuses
+        the compiled step. Levels <= c keep full-domain images — they
+        are at most coarse-image-sized."""
+        import math
         f = self.forest
         c = self._coarse_level = max(0, min(3, f.cfg.level_max - 1))
         bs_ = f.bs
@@ -431,40 +461,94 @@ class AMRSim(ShapeHostMixin):
         lvo = f.level[self._order].astype(np.int64)
         bio = f.bi[self._order].astype(np.int64)
         bjo = f.bj[self._order].astype(np.int64)
+        active = sorted(int(v) for v in np.unique(lvo))
+        # shared coarse-cell window over the fine levels' active tiles
+        fine_act = [l for l in active if l > c]
+        crop = None
+        if fine_act:
+            align = 1
+            for l in fine_act:
+                align = math.lcm(
+                    align, bs_ // math.gcd(bs_, 1 << (l - c)))
+            cj0 = ci0 = 1 << 30
+            cj1 = ci1 = -1
+            for l in fine_act:
+                sel = lvo == l
+                den = 1 << (l - c)       # level-l cells per coarse cell
+                cj0 = min(cj0, int(bjo[sel].min()) * bs_ // den)
+                ci0 = min(ci0, int(bio[sel].min()) * bs_ // den)
+                cj1 = max(cj1, -(-(int(bjo[sel].max()) + 1) * bs_ // den))
+                ci1 = max(ci1, -(-(int(bio[sel].max()) + 1) * bs_ // den))
+            # 2-coarse-cell margin: the bilinear chain's dependence
+            # reach (see docstring); snap outward to the alignment grid
+            # (domain dims are multiples of it, so clamping is safe)
+            cj0 = max(0, cj0 - 2) // align * align
+            ci0 = max(0, ci0 - 2) // align * align
+            cj1 = -(-min(ncy, cj1 + 2) // align) * align
+            ci1 = -(-min(ncx, ci1 + 2) // align) * align
+            crop = (cj0, cj1, ci0, ci1)
         per_level = {}
-        for l in sorted(int(v) for v in np.unique(lvo)):
+        fine = {}
+        for l in active:
             ntx = f.cfg.bpdx << l
             nty = f.cfg.bpdy << l
             sel = lvo == l
             if not np.any(sel):
                 # empty ladder level: never emit an entry — the
                 # _deposit/_interp chains in _pressure_project bound
-                # their image ladders by min/max of THIS dict, so an
+                # their image ladders by min/max of THESE dicts, so an
                 # empty level above the finest active one would force
-                # full-domain O(4^level) images for blocks that do not
-                # exist (ADVICE r5). np.unique of the active levels
-                # cannot produce one today; this guard keeps the
-                # invariant explicit for future callers.
+                # needless ladder steps (ADVICE r5). np.unique of the
+                # active levels cannot produce one today; this guard
+                # keeps the invariant explicit for future callers.
                 continue
-            tix = bjo[sel] * ntx + bio[sel]
-            # tiles owned by no level-l block gather the first pad row
-            # (index n_real points into the pad range: n_pad > n_real)
-            # and are zeroed by ownm — pad-row data is stale, not NaN
-            own = np.full(nty * ntx, n_real, np.int32)
-            own[tix] = np.nonzero(sel)[0].astype(np.int32)
-            ownm = np.zeros(nty * ntx, fdt)
-            ownm[tix] = 1.0
-            tid = np.zeros(n_pad, np.int32)
-            tid[:n_real][sel] = tix.astype(np.int32)
-            selp = np.zeros(n_pad, fdt)
-            selp[:n_real][sel] = 1.0
-            per_level[l] = (own.reshape(nty, ntx),
-                            ownm.reshape(nty, ntx), tid, selp)
+            if l <= c:
+                tix = bjo[sel] * ntx + bio[sel]
+                # tiles owned by no level-l block gather the first pad
+                # row (index n_real points into the pad range:
+                # n_pad > n_real) and are zeroed by ownm — pad-row
+                # data is stale, not NaN
+                own = np.full(nty * ntx, n_real, np.int32)
+                own[tix] = np.nonzero(sel)[0].astype(np.int32)
+                ownm = np.zeros(nty * ntx, fdt)
+                ownm[tix] = 1.0
+                tid = np.zeros(n_pad, np.int32)
+                tid[:n_real][sel] = tix.astype(np.int32)
+                selp = np.zeros(n_pad, fdt)
+                selp[:n_real][sel] = 1.0
+                per_level[l] = (own.reshape(nty, ntx),
+                                ownm.reshape(nty, ntx), tid, selp)
+            else:
+                cj0, cj1, ci0, ci1 = crop
+                sc = 1 << (l - c)
+                tj0 = cj0 * sc // bs_
+                ti0 = ci0 * sc // bs_
+                ntyw = (cj1 - cj0) * sc // bs_
+                ntxw = (ci1 - ci0) * sc // bs_
+                tjr = bjo[sel] - tj0
+                tir = bio[sel] - ti0
+                tix = tjr * ntxw + tir
+                own = np.full(ntyw * ntxw, n_real, np.int32)
+                own[tix] = np.nonzero(sel)[0].astype(np.int32)
+                ownm = np.zeros(ntyw * ntxw, fdt)
+                ownm[tix] = 1.0
+                tid = np.zeros(n_pad, np.int32)
+                tid[:n_real][sel] = tix.astype(np.int32)
+                selp = np.zeros(n_pad, fdt)
+                selp[:n_real][sel] = 1.0
+                fine[l] = (own.reshape(ntyw, ntxw),
+                           ownm.reshape(ntyw, ntxw), tid, selp)
         from .poisson import dct_neumann_operators
-        self._coarse_cw = jax.device_put({
+        cw = {
             "lev": per_level,
             "dct": dct_neumann_operators(ncy, ncx, dtype=fdt),
-        })
+        }
+        if fine:
+            cw["levf"] = fine
+            # window ORIGIN (coarse cells) — dynamic, so same-shape
+            # windows at different spots share one executable
+            cw["crop"] = np.asarray([crop[0], crop[2]], np.int32)
+        self._coarse_cw = jax.device_put(cw)
 
 
     # the hot-loop table sets that take the same-level face-copy fast
@@ -684,78 +768,11 @@ class AMRSim(ShapeHostMixin):
             # coarse solve — the r4 per-cell scatter/gather maps and
             # the FFT's operand staging were ~630 of 1163 ms/step at
             # 1e4 blocks (r5 trace; see _build_coarse_maps).
-            lev = tcoarse["lev"]
             dctops = tcoarse["dct"]
             ncy, ncx = self._coarse_shape
-            c = self._coarse_level
-            bs = cfg.bs
-            # ladder bounds: ``lev`` holds ONLY levels with active
-            # blocks (_build_coarse_maps filters empty ones), so the
-            # image chains below stop at the finest/coarsest ACTIVE
-            # level — a deeply compressed levelMax-8 forest never
-            # materializes finest-cap (~8M-cell) images per M
-            # application (ADVICE r5: skip empty ladder levels above
-            # the finest active one). Remaining scaling cliff,
-            # documented rather than paid for: each NON-empty level
-            # still paints a FULL-DOMAIN image at its own resolution —
-            # O(4^level) cells even when a single block is active
-            # there. Cropping to the active-tile bounding box is the
-            # next step if deep-refinement cases appear (ROADMAP open
-            # item).
-            lmin_p = min(lev)
-            lmax_p = max(lev)
             cih2 = jnp.where(hsq > 0,
                              1.0 / jnp.where(hsq > 0, hsq, 1.0), 0.0)
-
-            def _deposit(rp):
-                rc = jnp.zeros((ncy, ncx), rp.dtype)
-                for l in sorted(lev):
-                    own, ownm, tid, selp = lev[l]
-                    nty, ntx = own.shape
-                    img = rp[own.reshape(-1)] \
-                        * ownm.reshape(-1)[:, None, None]
-                    img = img.reshape(nty, ntx, bs, bs) \
-                             .transpose(0, 2, 1, 3) \
-                             .reshape(nty * bs, ntx * bs)
-                    if l > c:
-                        # mean ladder: each fine cell deposits its
-                        # area fraction 4^(c-l) (the r4 wq weight)
-                        for _ in range(l - c):
-                            img = _down2_mean(img)
-                    else:
-                        # coarser than c: spread the cell's unit
-                        # deposit uniformly over its coarse footprint
-                        for _ in range(c - l):
-                            img = jnp.repeat(
-                                jnp.repeat(img, 2, 0), 2, 1) * 0.25
-                    rc = rc + img
-                return rc
-
-            def _interp(ec, like):
-                # images are kept ONLY for levels with active blocks;
-                # gap levels inside [lmin_p, lmax_p] still pay their
-                # ladder step (the 2x chain is how level l+1 is built
-                # from l) but are never stored or extracted
-                imgs = {c: ec} if c in lev else {}
-                a = ec
-                for l in range(c + 1, lmax_p + 1):
-                    a = _up2_bilinear(a)
-                    if l in lev:
-                        imgs[l] = a
-                a = ec
-                for l in range(c - 1, lmin_p - 1, -1):
-                    a = _down2_mean(a)
-                    if l in lev:
-                        imgs[l] = a
-                e = jnp.zeros_like(like)
-                for l in sorted(lev):
-                    own, ownm, tid, selp = lev[l]
-                    nty, ntx = own.shape
-                    tiles = imgs[l].reshape(nty, bs, ntx, bs) \
-                                   .transpose(0, 2, 1, 3) \
-                                   .reshape(nty * ntx, bs, bs)
-                    e = e + tiles[tid] * selp[:, None, None]
-                return e
+            _deposit, _interp = self._coarse_transfers(tcoarse)
 
             # form selection: PRODUCTION solves use the ADDITIVE
             # two-level (coarse correction + block-Jacobi on the same
@@ -835,6 +852,97 @@ class AMRSim(ShapeHostMixin):
             dv, gradient_deposits(plab[:, 0], pfac), corr)
         v = (v + dv * ih2) * maskv
         return v, p_new[:, None], res, div_linf
+
+    def _coarse_transfers(self, tcoarse):
+        """The two-level transfer pair (deposit: ordered blocks ->
+        coarse image; interp: coarse image -> ordered blocks) for one
+        ``_build_coarse_maps`` pytree. Factored out of
+        _pressure_project so the cropped-vs-full-domain equivalence is
+        directly testable (tests/test_amr.py).
+
+        Ladder bounds: ``lev``/``levf`` hold ONLY levels with active
+        blocks (_build_coarse_maps filters empty ones), so the image
+        chains stop at the finest/coarsest ACTIVE level (ADVICE r5).
+        Levels FINER than c live in ``levf`` and are CROPPED to the
+        shared active-tile window — the former full-domain O(4^level)
+        cliff is closed: a fine level pays window-sized images, not
+        domain-sized ones, and the 2-coarse-cell margin keeps the
+        cropped bilinear chain bit-identical to the full-domain form
+        on every active cell (see _build_coarse_maps)."""
+        lev = tcoarse["lev"]
+        levf = tcoarse.get("levf", {})
+        crop = tcoarse.get("crop")
+        ncy, ncx = self._coarse_shape
+        c = self._coarse_level
+        bs = self.cfg.bs
+        if levf:
+            l0 = min(levf)
+            sc0 = 1 << (l0 - c)
+            hw, ww = levf[l0][0].shape
+            wHc = hw * bs // sc0        # window size, coarse cells
+            wWc = ww * bs // sc0
+            oy, ox = crop[0], crop[1]   # dynamic origin
+
+        def _tiles_img(entry, rp):
+            own, ownm, _, _ = entry
+            nty, ntx = own.shape
+            img = rp[own.reshape(-1)] \
+                * ownm.reshape(-1)[:, None, None]
+            return img.reshape(nty, ntx, bs, bs) \
+                      .transpose(0, 2, 1, 3) \
+                      .reshape(nty * bs, ntx * bs)
+
+        def _deposit(rp):
+            rc = jnp.zeros((ncy, ncx), rp.dtype)
+            for l in sorted(lev):               # levels <= c
+                img = _tiles_img(lev[l], rp)
+                # coarser than c: spread the cell's unit deposit
+                # uniformly over its coarse footprint
+                for _ in range(c - l):
+                    img = jnp.repeat(
+                        jnp.repeat(img, 2, 0), 2, 1) * 0.25
+                rc = rc + img
+            for l in sorted(levf):              # levels > c, cropped
+                img = _tiles_img(levf[l], rp)
+                # mean ladder: each fine cell deposits its area
+                # fraction 4^(c-l) (the r4 wq weight)
+                for _ in range(l - c):
+                    img = _down2_mean(img)
+                cur = jax.lax.dynamic_slice(rc, (oy, ox), (wHc, wWc))
+                rc = jax.lax.dynamic_update_slice(
+                    rc, cur + img, (oy, ox))
+            return rc
+
+        def _extract(a, entry, e):
+            own, _, tid, selp = entry
+            nty, ntx = own.shape
+            tiles = a.reshape(nty, bs, ntx, bs) \
+                     .transpose(0, 2, 1, 3) \
+                     .reshape(nty * ntx, bs, bs)
+            return e + tiles[tid] * selp[:, None, None]
+
+        def _interp(ec, like):
+            # images are kept ONLY for levels with active blocks; gap
+            # levels still pay their ladder step (the 2x chain is how
+            # level l+1 is built from l) but are never stored or
+            # extracted
+            e = jnp.zeros_like(like)
+            if c in lev:
+                e = _extract(ec, lev[c], e)
+            a = ec
+            for l in range(c - 1, (min(lev) if lev else c) - 1, -1):
+                a = _down2_mean(a)
+                if l in lev:
+                    e = _extract(a, lev[l], e)
+            if levf:
+                a = jax.lax.dynamic_slice(ec, (oy, ox), (wHc, wWc))
+                for l in range(c + 1, max(levf) + 1):
+                    a = _up2_bilinear(a)
+                    if l in levf:
+                        e = _extract(a, levf[l], e)
+            return e
+
+        return _deposit, _interp
 
     def _energy(self, v, hsq):
         """Kinetic energy of the masked ordered velocity — the
@@ -1466,26 +1574,35 @@ class AMRSim(ShapeHostMixin):
                 # instead of a full field reduction per step (the
                 # obstacle-free driver paid 2.3 s/step for compute_dt
                 # at 16k-pad through the tunnel, measured in the
-                # round-3 scale proof)
+                # round-3 scale proof). Under async_diag even that one
+                # scalar round trip goes: dt STAYS a device scalar fed
+                # straight into the dispatch (identical arithmetic, so
+                # the trajectory is bit-identical to the eager path —
+                # float()ing a device scalar and re-putting it is a
+                # lossless round trip).
                 with tm.phase("dt"):
                     if self._next_umax is not None:
                         # post-regrid: same 1.05 prolongation-overshoot
                         # guard as the obstacle path (ADVICE r2)
                         fac = (1.0 if self._next_umax_version
                                == f.version else 1.05)
-                        dt = self._float_pull(self._dt_from_umax(
+                        dt_dev = self._dt_from_umax(
                             fac * jnp.asarray(self._next_umax, f.dtype),
-                            self._hmin()))
+                            self._hmin())
+                        dt = (dt_dev if self.async_diag
+                              else self._float_pull(dt_dev))
                     else:
                         dt = self.compute_dt()
-            elif self._last_iters_dev is not None:
+            elif self._last_iters_dev is not None and not self.async_diag:
                 # explicit-dt callers still drain the iters scalar
+                # (async mode keeps it on device: the guard's lagged
+                # pull IS the drain — replay must not add pulls)
                 self._float_pull(jnp.zeros((), f.dtype))
             exact = self.step_count < 10 or self._force_exact
+            dt_dev = jnp.asarray(dt, f.dtype)
             with tm.phase("flow"):
                 vel, pres, diag = self._step_jit(
-                    ordf["vel"], ordf["pres"],
-                    jnp.asarray(dt, f.dtype),
+                    ordf["vel"], ordf["pres"], dt_dev,
                     self._h, self._hsq_flat, self._maskv,
                     self._tables["vec3"], self._tables["vec1"],
                     self._tables["sca1"], self._tables["pois"],
@@ -1504,6 +1621,16 @@ class AMRSim(ShapeHostMixin):
                     # spuriously trip the production trigger on
                     # compressed forests (code-review r4)
                     self._last_iters_dev = diag["poisson_iters"]
+                if self.async_diag:
+                    diag = dict(diag)
+                    diag["dt"] = dt_dev      # the lagged clock's source
+                    self.step_count += 1
+                    return diag              # no fence: no host sync
+                diag = dict(diag)
+                # the EXACT dt used (host float here), for the guard's
+                # replay record — a time-difference reconstruction is
+                # off by an ulp (review PR 4)
+                diag["dt"] = float(dt)
                 tm.fence("flow", vel)   # charge flow to "flow"
             self.time += dt
             self.step_count += 1
@@ -1587,6 +1714,7 @@ class AMRSim(ShapeHostMixin):
             # the ONE host pull of the step
             uvw, com, mass, inertia, dt_next, diag, forces = \
                 jax.device_get((*scalars, forces))
+            diag["dt"] = float(dt)    # exact replay record (see above)
             # the scalar pull alone does not prove the fields landed
             tm.fence("flow", vel)
         self._sync_shape_scalars_np(com, mass, inertia)

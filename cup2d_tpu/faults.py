@@ -41,6 +41,7 @@ same principle as the CUP2D_POIS/CUP2D_TWOLEVEL gate validation
 
 from __future__ import annotations
 
+import contextlib
 import os
 import signal
 from typing import Optional
@@ -64,6 +65,12 @@ class FaultPlan:
         self.giveup: dict[int, int] = {}        # step -> count
         self.sigterm_steps: set[int] = set()
         self.crash_points: dict[str, int] = {}  # name -> count
+        # replay suspension (StepGuard.snapshot-cadence recovery): a
+        # restore-and-replay re-runs ALREADY-VERDICTED-GOOD steps, so
+        # an armed *K fault whose step lands mid-replay must not fire
+        # into it — replay is bit-exact reproduction, not a fresh
+        # attempt. The guard wraps the replay in suspend().
+        self._suspended = 0
         for tok in (spec or "").split(","):
             tok = tok.strip()
             if not tok:
@@ -110,26 +117,45 @@ class FaultPlan:
         return bool(self.vel_poison or self.vel_scale or self.giveup
                     or self.sigterm_steps or self.crash_points)
 
+    # -- replay suspension --------------------------------------------
+    @contextlib.contextmanager
+    def suspend(self):
+        """Context manager: no fault fires inside (guard replay)."""
+        self._suspended += 1
+        try:
+            yield
+        finally:
+            self._suspended -= 1
+
     # -- hooks consulted by the guard / io ----------------------------
-    def apply_pre_step(self, sim) -> bool:
+    def apply_pre_step(self, sim) -> list:
         """Poison or scale the velocity before an attempt of the
-        current step. Returns whether a fault fired (and consumed one
-        count)."""
-        fired = False
+        current step. Returns the consumed [value, count] entries
+        (truthy when anything fired) so the StepGuard can REFUND a
+        dispatch it later discards: under the lagged verdict, a step
+        dispatched on top of a not-yet-detected bad step is thrown
+        away and re-dispatched after recovery — a fault armed for it
+        must fire at the real dispatch, not be eaten by the garbage
+        one."""
+        if self._suspended:
+            return []
+        fired = []
         ent = self.vel_poison.get(sim.step_count)
         if ent and ent[1] > 0:
             ent[1] -= 1
             poison_velocity(sim, ent[0])
-            fired = True
+            fired.append(ent)
         ent = self.vel_scale.get(sim.step_count)
         if ent and ent[1] > 0:
             ent[1] -= 1
             scale_velocity(sim, ent[0])
-            fired = True
+            fired.append(ent)
         return fired
 
     def poisson_giveup_at(self, step: int) -> bool:
         """Consume one forced-give-up count for ``step`` if armed."""
+        if self._suspended:
+            return False
         c = self.giveup.get(step, 0)
         if c <= 0:
             return False
@@ -138,6 +164,8 @@ class FaultPlan:
 
     def fire_post_step(self, step: int) -> None:
         """Post-step faults: SIGTERM delivery (preemption)."""
+        if self._suspended:
+            return
         if step in self.sigterm_steps:
             self.sigterm_steps.discard(step)
             os.kill(os.getpid(), signal.SIGTERM)

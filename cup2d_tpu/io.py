@@ -191,11 +191,18 @@ def read_dump(path: str):
 
 def _gather_state(sim):
     """Collect the full checkpoint payload (host numpy fields) + meta
-    dict. The shared gather half of ``save_checkpoint`` and the
-    StepGuard's in-RAM snapshots (resilience.py) — one machinery, so a
-    ring rewind restores EXACTLY what a disk restore would. COLLECTIVE
-    on pods (field all-gathers); every process must call it in the same
-    order."""
+    dict. The shared gather half of ``save_checkpoint`` and the host
+    :class:`Snapshot` machinery — one machinery, so a restore installs
+    EXACTLY what a disk restore would. COLLECTIVE on pods (field
+    all-gathers); every process must call it in the same order.
+
+    This is the FULL D2H state gather — since the device snapshot ring
+    (:func:`snapshot_state_device`) took over the StepGuard's rewind
+    path, it runs only for disk checkpoints and post-mortems, never per
+    step; ``profiling.HostCounters.state_gathers`` counts invocations
+    so the CI sync guard can assert exactly that."""
+    from .profiling import _note_state_gather
+    _note_state_gather()
     if hasattr(sim, "sync_fields"):
         # the adaptive driver's per-step truth is its ordered working
         # state; flush it into the slot-layout dict read below
@@ -436,37 +443,194 @@ def _install_state(sim, data, meta: dict, shapes) -> None:
 
 
 # ---------------------------------------------------------------------------
-# in-RAM snapshots (the StepGuard's rewind ring, resilience.py)
+# device-resident snapshots (the StepGuard's HBM ring, resilience.py)
 # ---------------------------------------------------------------------------
+# The PR-2 host ring gathered the full state to host RAM per good step
+# — a real per-step D2H tax through a TPU tunnel (the former ROADMAP
+# pod gap (b)). The device snapshots keep the ring IN HBM: entries are
+# donation-safe jnp copies of the state pytree (no transfer — the copy
+# is enqueued on the device stream before the next step's jit donates
+# the source buffers, so stream order guarantees it reads pre-donation
+# data), restore is a device-to-device copy back, and the host only
+# ever sees the small meta scalars. Host gathers remain exactly where
+# they belong: disk checkpoints and post-mortems (_gather_state).
+#
+# Multi-host: jnp copies of sharded arrays are per-shard local (no
+# collective, unlike the host gather) and restores reinstall the same
+# sharding — the ring is pod-safe by construction.
 
-class Snapshot(NamedTuple):
-    """One good state in host RAM: the checkpoint payload without the
-    disk. ``meta`` is json-round-tripped and ``shapes`` pickled at
-    capture time so a rewind installs EXACTLY what a disk restore of a
-    checkpoint taken at that instant would (same machinery, same
-    serialization semantics), and later in-place shape mutation cannot
-    leak back into the ring."""
 
-    payload: dict           # field name -> numpy array
-    meta: dict
-    shapes_pkl: object      # bytes | None
+def device_copy(x):
+    """Donation-safe device-to-device copy of a jax array (host leaves
+    pass through as numpy copies). ``copy=True`` guarantees a fresh XLA
+    buffer: the stepping jits DONATE the state, so a ring entry must
+    never alias a buffer a later dispatch invalidates — and a restored
+    entry must survive being donated itself."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(x, jax.Array):
+        return jnp.array(x, copy=True)
+    return np.array(x)
 
 
-def snapshot_state(sim) -> Snapshot:
-    """Capture ``sim`` into host RAM (COLLECTIVE on pods, exactly like
-    save_checkpoint — every process holds the full ring, so every
-    process can rewind to the same state)."""
-    payload, meta = _gather_state(sim)
+class DeviceSnapshot(NamedTuple):
+    """One state in HBM: device copies of the field pytree + host meta.
+
+    ``dev`` carries the dt-cache entries that are device scalars at
+    capture time (the async/lagged drivers keep ``_next_dt`` /
+    ``_next_umax`` / ``_last_iters_dev`` on device — float()ing them
+    here would be exactly the blocking sync the ring exists to kill).
+    ``meta['time']`` is patched by the StepGuard at verdict time on the
+    lagged paths (the host clock is settled one step behind capture)."""
+
+    payload: dict        # field name -> device array copy
+    meta: dict           # host scalars (+ forest keys for topo restore)
+    dev: dict            # dt-cache entries still on device
+    shapes_pkl: object   # bytes | None
+
+
+def _split_cache(meta: dict, dev: dict, name: str, val) -> None:
+    """File a dt-cache value under meta (host) or dev (device copy)."""
+    import jax
+
+    if isinstance(val, jax.Array):
+        dev[name] = device_copy(val)
+    elif val is not None:
+        meta[name] = float(val)
+
+
+def snapshot_state_device(sim) -> "DeviceSnapshot":
+    """Capture ``sim`` into an HBM-resident snapshot: zero host
+    transfers, zero collectives. The forest topology metadata is host
+    numpy already (level/bi/bj); the ordered device fields are copied
+    in place."""
+    meta = {"time": sim.time, "step_count": sim.step_count}
+    dev: dict = {}
+    if hasattr(sim, "forest"):
+        f = sim.forest
+        ordf = sim._ordered_state()
+        payload = {k: device_copy(v) for k, v in ordf.items()}
+        order = sim._order
+        meta.update(
+            kind="forest",
+            forest_version=f.version,
+            n_real=int(sim._n_real),
+            keys=np.stack([f.level[order], f.bi[order], f.bj[order]],
+                          axis=1).astype(np.int32),
+            next_dt_current=bool(sim._next_dt is not None
+                                 and sim._next_dt_version == f.version),
+            next_umax_current=bool(
+                sim._next_umax is not None
+                and getattr(sim, "_next_umax_version", -1) == f.version),
+            last_iters=int(sim._last_iters),
+            coarse_on=bool(sim._coarse_on),
+        )
+        _split_cache(meta, dev, "next_dt", sim._next_dt)
+        _split_cache(meta, dev, "next_umax", sim._next_umax)
+        if sim._last_iters_dev is not None:
+            dev["last_iters_dev"] = device_copy(sim._last_iters_dev)
+    else:
+        payload = {k: device_copy(v)
+                   for k, v in sim.state._asdict().items()}
+        meta["kind"] = "uniform"
+        _split_cache(meta, dev, "next_dt", getattr(sim, "_next_dt", None))
     shapes = getattr(sim, "shapes", None)
-    return Snapshot(
-        payload=payload,
-        meta=json.loads(json.dumps(meta)),
-        shapes_pkl=pickle.dumps(list(shapes)) if shapes is not None
-        else None)
+    return DeviceSnapshot(
+        payload=payload, meta=meta, dev=dev,
+        shapes_pkl=pickle.dumps(list(shapes)) if shapes else None)
 
 
-def restore_snapshot(sim, snap: Snapshot) -> None:
-    """Install a snapshot back into ``sim`` (the StepGuard rewind)."""
-    shapes = (pickle.loads(snap.shapes_pkl)
-              if snap.shapes_pkl is not None else None)
-    _install_state(sim, snap.payload, snap.meta, shapes)
+def snapshot_nbytes(snap) -> int:
+    """HBM footprint of one snapshot's field payload (host metadata on
+    the arrays — no sync)."""
+    return int(sum(getattr(v, "nbytes", 0)
+                   for v in snap.payload.values()))
+
+
+def _restore_cache(sim, snap: DeviceSnapshot, fver=None) -> None:
+    meta, dev = snap.meta, snap.dev
+    if hasattr(sim, "_next_dt"):
+        nd = dev.get("next_dt")
+        sim._next_dt = (device_copy(nd) if nd is not None
+                        else meta.get("next_dt"))
+        if hasattr(sim, "_next_dt_version"):
+            sim._next_dt_version = (
+                fver if meta.get("next_dt_current") else -1)
+    if hasattr(sim, "_next_umax"):
+        nu = dev.get("next_umax")
+        sim._next_umax = (device_copy(nu) if nu is not None
+                          else meta.get("next_umax"))
+        sim._next_umax_version = (
+            fver if meta.get("next_umax_current") else -1)
+    if hasattr(sim, "_last_iters"):
+        sim._last_iters = int(meta.get("last_iters", 0))
+        li = dev.get("last_iters_dev")
+        sim._last_iters_dev = device_copy(li) if li is not None else None
+    if hasattr(sim, "_coarse_on"):
+        sim._coarse_on = bool(meta.get("coarse_on", False))
+
+
+def restore_snapshot_device(sim, snap: DeviceSnapshot) -> None:
+    """Install a device snapshot back into ``sim``, device-to-device.
+
+    Same-topology restores (the only ones the StepGuard's ladder issues
+    — the guard re-anchors its ring after every regrid) install copies
+    of the ordered working state directly. A topology-mismatched
+    snapshot falls back to the full reinstall path (_install_state fed
+    device arrays — still device-to-device for the fields; only the
+    dt-cache scalars are resolved to host floats there)."""
+    meta = snap.meta
+    if meta["kind"] == "forest":
+        f = sim.forest
+        if meta["forest_version"] == f.version and sim._ord is not None \
+                and next(iter(snap.payload.values())).shape[0] \
+                == next(iter(sim._ord.values())).shape[0]:
+            sim.time = float(meta["time"])
+            sim.step_count = int(meta["step_count"])
+            sim._ord = {k: device_copy(v)
+                        for k, v in snap.payload.items()}
+            # the restored ordered state is now the truth; the slot
+            # fields are stale until the next sync_fields()
+            sim._ord_key = (f.version, f.fields.wver)
+            sim._ord_dirty = True
+            _restore_cache(sim, snap, fver=f.version)
+        else:
+            # topology moved since capture: rebuild through the shared
+            # install half (counters-before-refresh ordering and the
+            # _ord re-anchor live there). Device scalars in the cache
+            # must become floats for the meta dict — one cold pull.
+            import jax
+            dev = {k: float(np.asarray(v))
+                   for k, v in jax.device_get(snap.dev).items()}
+            m2 = {
+                "time": meta["time"], "step_count": meta["step_count"],
+                "dt_cache": {
+                    "next_dt": dev.get("next_dt", meta.get("next_dt")),
+                    "next_dt_current": meta["next_dt_current"],
+                    "next_umax": dev.get("next_umax",
+                                         meta.get("next_umax")),
+                    "next_umax_current": meta["next_umax_current"],
+                },
+                "poisson_trigger": {
+                    "coarse_on": meta["coarse_on"],
+                    "last_iters": int(dev.get("last_iters_dev",
+                                              meta["last_iters"])),
+                },
+            }
+            n_real = meta["n_real"]
+            data = {"__forest_keys": meta["keys"],
+                    **{k: v[:n_real] for k, v in snap.payload.items()}}
+            shapes = (pickle.loads(snap.shapes_pkl)
+                      if snap.shapes_pkl is not None else None)
+            _install_state(sim, data, m2, shapes)
+            return
+    else:
+        sim.time = float(meta["time"])
+        sim.step_count = int(meta["step_count"])
+        sim.state = type(sim.state)(
+            **{k: device_copy(v) for k, v in snap.payload.items()})
+        _restore_cache(sim, snap)
+    if getattr(sim, "shapes", None) and snap.shapes_pkl is not None:
+        sim.shapes[:] = pickle.loads(snap.shapes_pkl)
+        sim._initialized = True

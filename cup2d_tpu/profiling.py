@@ -272,6 +272,15 @@ def _install_hooks() -> None:
         jax.device_get = _counting_device_get
 
 
+def _note_state_gather() -> None:
+    """io._gather_state reports each FULL D2H state gather here: the
+    device snapshot ring exists so steady-state supervised steps make
+    zero of these (disk checkpoints and post-mortems only), and the CI
+    sync guard asserts it through this counter."""
+    for c in _ACTIVE_COUNTERS:
+        c.state_gathers += 1
+
+
 def hbm_peak_bytes() -> Optional[int]:
     """HBM high-water mark of the first local device, or None where the
     backend reports no allocator stats (CPU)."""
@@ -293,7 +302,12 @@ class HostCounters:
       must trigger ZERO of these; `tests/test_telemetry.py` guards it).
     - ``device_gets``: explicit device→host pulls (`jax.device_get`
       calls — the drivers' batched per-step pull discipline makes this
-      exactly one per step on the hot paths).
+      exactly one per step on the hot paths, and under the lagged
+      StepGuard verdict that one pull is issued AFTER the next step's
+      dispatch, off the critical path).
+    - ``state_gathers``: full D2H state gathers (io._gather_state) —
+      zero in steady state since the device snapshot ring; nonzero only
+      at disk checkpoints/post-mortems.
     - HBM high-water via :func:`hbm_peak_bytes` (absolute, not delta:
       the allocator reports a process-lifetime peak).
 
@@ -304,6 +318,7 @@ class HostCounters:
     def __init__(self):
         self.jit_compiles = 0
         self.device_gets = 0
+        self.state_gathers = 0
 
     def install(self) -> "HostCounters":
         _install_hooks()
@@ -317,7 +332,8 @@ class HostCounters:
 
     def snapshot(self) -> dict:
         return {"jit_compiles": self.jit_compiles,
-                "device_gets": self.device_gets}
+                "device_gets": self.device_gets,
+                "state_gathers": self.state_gathers}
 
 
 # ---------------------------------------------------------------------------
@@ -328,7 +344,7 @@ class HostCounters:
 # always present; fields that do not apply to a path (AMR shape on a
 # uniform run, comm volume on a single device, counters when disabled)
 # are null — consumers key on names, never on presence.
-METRICS_SCHEMA_VERSION = 1
+METRICS_SCHEMA_VERSION = 2
 METRICS_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     # solver health + timestep state (the step's existing diag pull)
@@ -341,8 +357,14 @@ METRICS_KEYS = (
     "n_blocks", "blocks_per_level", "refines", "coarsens",
     # comm volume (shard surface-exchange plan, per one vec3 exchange)
     "halo_real_bytes", "halo_padded_bytes",
-    # host-side counters (per-step deltas; hbm peak is absolute)
-    "jit_compiles", "device_gets", "hbm_peak_bytes",
+    # host-side counters (per-step deltas; hbm peak is absolute);
+    # state_gathers counts FULL D2H state gathers — zero in guarded
+    # steady state since the device snapshot ring (schema v2)
+    "jit_compiles", "device_gets", "state_gathers", "hbm_peak_bytes",
+    # supervision state (schema v2): device snapshot ring HBM footprint
+    # (absolute bytes) + replayed-step delta of the snapshot-cadence
+    # recovery path — the D2H win made visible in post --metrics
+    "snap_ring_bytes", "replayed_steps",
     # merged PhaseTimers wall times (per-step deltas, ms)
     "phase_ms",
 )
@@ -378,14 +400,16 @@ class MetricsRecorder:
     cached per topology version, and counters/timers are host state."""
 
     def __init__(self, sink=None, counters: Optional[HostCounters] = None,
-                 timers: Optional[PhaseTimers] = None):
+                 timers: Optional[PhaseTimers] = None, guard=None):
         self.sink = sink
         self.counters = counters
         self.timers = timers
+        self.guard = guard          # resilience.StepGuard, opt-in
         self._last_time: Optional[float] = None
         self._last_counters = counters.snapshot() if counters else None
         self._last_phase: dict = dict(timers.acc) if timers else {}
         self._last_regrid = (0, 0)
+        self._last_replayed = 0
         self._lvl_cache = (None, None, None)   # (version, hist, n)
 
     def prime(self, sim) -> None:
@@ -427,6 +451,7 @@ class MetricsRecorder:
         rec.update(self._amr_fields(sim))
         rec.update(self._comm_fields(sim))
         rec.update(self._counter_fields())
+        rec.update(self._guard_fields())
         rec["phase_ms"] = self._phase_fields()
         if self.sink is not None:
             self.sink.emit(event="metrics", **rec)
@@ -463,15 +488,29 @@ class MetricsRecorder:
     def _counter_fields(self) -> dict:
         if self.counters is None:
             return {"jit_compiles": None, "device_gets": None,
-                    "hbm_peak_bytes": None}
+                    "state_gathers": None, "hbm_peak_bytes": None}
         cur = self.counters.snapshot()
         last = self._last_counters or {k: 0 for k in cur}
         self._last_counters = cur
         return {
             "jit_compiles": cur["jit_compiles"] - last["jit_compiles"],
             "device_gets": cur["device_gets"] - last["device_gets"],
+            "state_gathers": (cur["state_gathers"]
+                              - last.get("state_gathers", 0)),
             "hbm_peak_bytes": hbm_peak_bytes(),
         }
+
+    def _guard_fields(self) -> dict:
+        """Supervision telemetry: the device snapshot ring's HBM bytes
+        (absolute — host metadata on the arrays, no sync) and the
+        replayed-step delta of the snapshot-cadence recovery path."""
+        if self.guard is None:
+            return {"snap_ring_bytes": None, "replayed_steps": None}
+        cur = int(getattr(self.guard, "replayed_steps", 0))
+        delta = cur - self._last_replayed
+        self._last_replayed = cur
+        return {"snap_ring_bytes": int(self.guard.ring_nbytes()),
+                "replayed_steps": delta}
 
     def _phase_fields(self) -> Optional[dict]:
         if self.timers is None:
@@ -537,5 +576,13 @@ def summarize_metrics(records: list) -> dict:
                           if col("refines") else None),
         "coarsens_total": (sum(col("coarsens"))
                            if col("coarsens") else None),
+        # supervision (schema v2): zero steady-state state_gathers is
+        # the device-ring win; replays say what recovery cost
+        "state_gathers_total": (sum(col("state_gathers"))
+                                if col("state_gathers") else None),
+        "snap_ring_bytes": (max(col("snap_ring_bytes"))
+                            if col("snap_ring_bytes") else None),
+        "replayed_steps_total": (sum(col("replayed_steps"))
+                                 if col("replayed_steps") else None),
     }
     return out

@@ -118,6 +118,15 @@ class Simulation(ShapeHostMixin):
         # solve on a retried step (resilience.py); OR-ed with the
         # reference's first-10-steps override below
         self._force_exact = False
+        # lagged-verdict mode (resilience.StepGuard, lag=True): the
+        # obstacle-free branch keeps the whole diag — including the dt
+        # actually used and the cached dt_next — ON DEVICE, skips its
+        # blocking pull and leaves the clock to the guard's lagged
+        # verdict, so the device never waits on the host in steady
+        # state. The shaped branch ignores this flag: its uvw/CoM pull
+        # feeds the HOST kinematics of the next step and is inherently
+        # synchronous.
+        self.async_diag = False
 
     # ------------------------------------------------------------------
     # device: rasterization + chi + integrals (ongrid, main.cpp:4208-4630)
@@ -355,19 +364,40 @@ class Simulation(ShapeHostMixin):
             tm = self.timers or NULL_TIMERS
             if dt is None:
                 if self._next_dt is not None:
+                    # host float on the sync path; under async_diag the
+                    # cached dt_next is a DEVICE scalar fed straight
+                    # back into the dispatch — no host round trip
                     dt = self._next_dt
                 else:
                     with tm.phase("dt"):
                         dt = float(self._dt(self.state.vel))
             exact = self.step_count < 10 or self._force_exact
+            dt_dev = jnp.asarray(dt, g.dtype)
+            if self.async_diag:
+                self.state, diag = self._flow_step_empty(
+                    self.state, dt_dev,
+                    exact_poisson=exact, obstacle_terms=False)
+                diag = dict(diag)
+                diag["dt"] = dt_dev          # the lagged clock's source
+                self._next_dt = diag["dt_next"]
+                # time deliberately NOT advanced (no host value for dt
+                # exists yet); sim.step_count stays exact — it is pure
+                # host arithmetic. Phase fences are skipped: a fence is
+                # a host sync, the thing this mode removes.
+                self.step_count += 1
+                return diag
             with tm.phase("flow"):
                 self.state, diag = self._flow_step_empty(
-                    self.state, jnp.asarray(dt, g.dtype),
+                    self.state, dt_dev,
                     exact_poisson=exact, obstacle_terms=False)
                 # ONE batched pull of the whole diag dict (same single
                 # transfer that used to fetch dt_next alone) — the
                 # health verdict then reads pure host scalars for free
                 diag = jax.device_get(diag)
+                # the EXACT dt used, for the guard's replay record —
+                # reconstructing it as time-after minus time-before
+                # rounds differently by an ulp (review PR 4)
+                diag["dt"] = float(dt)
                 self._next_dt = float(diag["dt_next"])
                 tm.fence("flow", self.state)
             self.time += dt
@@ -411,6 +441,7 @@ class Simulation(ShapeHostMixin):
             # driver's umax read then cost no further transfers
             uvw_np, diag = jax.device_get((uvw, diag))
             uvw_np = np.asarray(uvw_np, dtype=np.float64)
+            diag["dt"] = float(dt)    # exact replay record (see above)
             self._next_dt = float(diag["dt_next"])
             # the scalar pull alone does not prove the donated state
             # landed; charge the field compute to "flow", not to the

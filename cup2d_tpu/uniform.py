@@ -334,6 +334,15 @@ class UniformSim:
         self.state = self.grid.zero_state()
         self.time = 0.0
         self.step_count = 0
+        self.shapes: list = []          # obstacle-free by construction
+        self.timers = None
+        self.force_log = None
+        self._next_dt = None            # cached end-state dt_next
+        # supervision hooks (resilience.StepGuard): escalation-rung
+        # exact solve + the lagged-verdict device-diag mode — see
+        # sim.Simulation for the contract
+        self._force_exact = False
+        self.async_diag = False
         # donate the state: without it XLA copies the pass-through
         # fields (us/udef/chi) every step — 3.3 ms/step of dead copies
         # at 8192^2 (round-4 trace). Callers read the NEW state from the
@@ -344,6 +353,36 @@ class UniformSim:
             self.grid.step, donate_argnums=(0,),
             static_argnames=("exact_poisson", "obstacle_terms"))
         self._dt = jax.jit(self.grid.compute_dt)
+
+    def step_once(self, dt: Optional[float] = None):
+        """One supervised-loop-compatible step (the StepGuard driver
+        contract shared with Simulation/AMRSim): cached device dt_next,
+        one batched diag pull — or, under ``async_diag``, no pull at
+        all: the diag (incl. the dt used) stays on device and the
+        guard's lagged verdict settles the clock."""
+        g = self.grid
+        if dt is None:
+            if self._next_dt is not None:
+                dt = self._next_dt
+            else:
+                dt = float(self._dt(self.state.vel))
+        exact = self.step_count < 10 or self._force_exact
+        dt_dev = jnp.asarray(dt, g.dtype)
+        self.state, diag = self._step(
+            self.state, dt_dev,
+            exact_poisson=exact, obstacle_terms=False)
+        if self.async_diag:
+            diag = dict(diag)
+            diag["dt"] = dt_dev
+            self._next_dt = diag["dt_next"]
+            self.step_count += 1
+            return diag
+        diag = jax.device_get(diag)
+        diag["dt"] = float(dt)   # exact dt for the guard's replay record
+        self._next_dt = float(diag["dt_next"])
+        self.time += dt
+        self.step_count += 1
+        return diag
 
     def advance(self, n_steps: int = 1, tend: Optional[float] = None,
                 exact_first_steps: bool = False):

@@ -542,9 +542,7 @@ def test_two_level_ladder_bounded_by_active_levels():
     """The two-level preconditioner's per-level image ladder must stop
     at the finest ACTIVE level (ADVICE r5 / PR 2): a levelMax-6 forest
     sitting entirely at level 1 must not carry level-5 full-domain
-    image entries (O(4^level) cells) through _deposit/_interp. The
-    remaining full-domain-per-NON-empty-level cost is a documented
-    scaling cliff (amr._pressure_project)."""
+    image entries (O(4^level) cells) through _deposit/_interp."""
     cfg = SimConfig(bpdx=1, bpdy=1, level_max=6, level_start=1,
                     extent=1.0, dtype="float64")
     sim = AMRSim(cfg, shapes=[])
@@ -552,5 +550,133 @@ def test_two_level_ladder_bounded_by_active_levels():
     cw = sim._use_coarse(True)
     active = {int(v) for v in np.unique(sim.forest.level[sim._order])}
     assert set(cw["lev"].keys()) == active == {1}
+    assert "levf" not in cw        # nothing finer than the coarse level
+
+
+def _deep_corner_sim():
+    """A levelMax-5 forest with one deep-refinement corner: level-2
+    background, a level-3 patch, a level-4 spot (2:1 everywhere)."""
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=5, level_start=2,
+                    extent=1.0, dtype="float64")
+    sim = AMRSim(cfg, shapes=[])
+    f = sim.forest
+    f.release(2, 0, 0)
+    for a in (0, 1):
+        for b in (0, 1):
+            f.allocate(3, a, b)
+    f.release(3, 0, 0)
+    for a in (0, 1):
+        for b in (0, 1):
+            f.allocate(4, a, b)
+    sim._refresh()
+    return sim
+
+
+def _full_domain_transfers(sim):
+    """The PR-2/PR-3 FULL-DOMAIN two-level transfers, reimplemented as
+    the test oracle for the cropped production form (one image per
+    non-empty level at its own resolution — the O(4^level) cliff the
+    crop closes)."""
+    from cup2d_tpu.amr import _down2_mean, _up2_bilinear
+    f = sim.forest
+    c = sim._coarse_level
+    bs = f.bs
+    ncy, ncx = sim._coarse_shape
+    lvo = f.level[sim._order].astype(np.int64)
+    bio = f.bi[sim._order].astype(np.int64)
+    bjo = f.bj[sim._order].astype(np.int64)
+    n_real = sim._n_real
+    n_pad = sim._npad_hwm
+    per = {}
+    for l in sorted(int(v) for v in np.unique(lvo)):
+        ntx, nty = f.cfg.bpdx << l, f.cfg.bpdy << l
+        sel = lvo == l
+        tix = bjo[sel] * ntx + bio[sel]
+        own = np.full(nty * ntx, n_real, np.int32)
+        own[tix] = np.nonzero(sel)[0].astype(np.int32)
+        ownm = np.zeros(nty * ntx)
+        ownm[tix] = 1.0
+        tid = np.zeros(n_pad, np.int32)
+        tid[:n_real][sel] = tix.astype(np.int32)
+        selp = np.zeros(n_pad)
+        selp[:n_real][sel] = 1.0
+        per[l] = (own.reshape(nty, ntx), ownm.reshape(nty, ntx),
+                  jnp.asarray(tid), jnp.asarray(selp))
+
+    def deposit(rp):
+        rc = jnp.zeros((ncy, ncx), rp.dtype)
+        for l in sorted(per):
+            own, ownm, _, _ = per[l]
+            nty, ntx = own.shape
+            img = rp[own.reshape(-1)] \
+                * jnp.asarray(ownm.reshape(-1))[:, None, None]
+            img = img.reshape(nty, ntx, bs, bs).transpose(0, 2, 1, 3) \
+                     .reshape(nty * bs, ntx * bs)
+            if l > c:
+                for _ in range(l - c):
+                    img = _down2_mean(img)
+            else:
+                for _ in range(c - l):
+                    img = jnp.repeat(jnp.repeat(img, 2, 0), 2, 1) * 0.25
+            rc = rc + img
+        return rc
+
+    def interp(ec, like):
+        imgs = {c: ec} if c in per else {}
+        a = ec
+        for l in range(c + 1, max(per) + 1):
+            a = _up2_bilinear(a)
+            if l in per:
+                imgs[l] = a
+        a = ec
+        for l in range(c - 1, min(per) - 1, -1):
+            a = _down2_mean(a)
+            if l in per:
+                imgs[l] = a
+        e = jnp.zeros_like(like)
+        for l in sorted(per):
+            own, _, tid, selp = per[l]
+            nty, ntx = own.shape
+            tiles = imgs[l].reshape(nty, bs, ntx, bs) \
+                           .transpose(0, 2, 1, 3) \
+                           .reshape(nty * ntx, bs, bs)
+            e = e + tiles[tid] * selp[:, None, None]
+        return e
+
+    return deposit, interp
+
+
+def test_two_level_crop_matches_full_domain():
+    """Cropping the fine-level (l > c) transfer images to the
+    active-tile bounding box must be BIT-IDENTICAL to the full-domain
+    form on every active cell — the 2-coarse-cell margin covers the
+    bilinear up-ladder's dependence reach, so the crop is a pure cost
+    optimization, not an approximation (the former ROADMAP
+    O(4^level)-image cliff, amr._build_coarse_maps)."""
+    sim = _deep_corner_sim()
+    cw = sim._use_coarse(True)
+    c = sim._coarse_level
+    assert c == 3
+    # the level-4 entry is cropped: window tiles strictly fewer than
+    # the 16x16 full-domain tile grid
+    assert set(cw["levf"].keys()) == {4}
+    ntyw, ntxw = cw["levf"][4][0].shape
+    assert ntyw < 16 and ntxw < 16
+    assert set(cw["lev"].keys()) == {2, 3}
+
+    rng = np.random.default_rng(7)
+    n_pad = sim._npad_hwm
+    bs = sim.forest.bs
+    rp = jnp.asarray(rng.standard_normal((n_pad, bs, bs)))
+    ncy, ncx = sim._coarse_shape
+    ec = jnp.asarray(rng.standard_normal((ncy, ncx)))
+
+    dep_c, itp_c = sim._coarse_transfers(cw)
+    dep_f, itp_f = _full_domain_transfers(sim)
+    assert np.array_equal(np.asarray(dep_c(rp)), np.asarray(dep_f(rp)))
+    got = np.asarray(itp_c(ec, rp))
+    want = np.asarray(itp_f(ec, rp))
+    # pad rows are zero in both (selp masks them); active rows bitwise
+    assert np.array_equal(got, want)
     # and the exact solve actually runs through the bounded ladder
     sim.step_once(dt=1e-3)

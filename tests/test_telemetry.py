@@ -247,9 +247,10 @@ def test_metrics_on_bit_identical_equal_pulls(tmp_path, monkeypatch):
 
 def test_metrics_no_second_pull_on_device_diag(monkeypatch):
     """The obstacle-free AMR step deliberately keeps its diag scalars
-    ON DEVICE; the guard's verdict pulls them once (batched), and the
-    guard must hand those host values to the recorder — metrics-on must
-    not re-pull what the verdict already fetched (code review PR 3)."""
+    ON DEVICE; the guard's LAGGED verdict pulls them once (batched,
+    after the next step's dispatch), and the guard must hand those host
+    values to the recorder — metrics-on must not re-pull what the
+    verdict already fetched (code review PR 3; lagged since PR 4)."""
     from cup2d_tpu.amr import AMRSim
     cfg = SimConfig(bpdx=1, bpdy=1, level_max=2, level_start=1,
                     extent=1.0, dtype="float64", nu=1e-3,
@@ -261,7 +262,7 @@ def test_metrics_no_second_pull_on_device_diag(monkeypatch):
         f.fields["vel"] = f.fields["vel"] + jnp.asarray(
             0.1 * rng.standard_normal(f.fields["vel"].shape))
         guard = StepGuard(sim)
-        rec = MetricsRecorder() if metrics else None
+        rec = MetricsRecorder(guard=guard) if metrics else None
         pulls = {"n": 0}
         real_get = jax.device_get
 
@@ -269,12 +270,18 @@ def test_metrics_no_second_pull_on_device_diag(monkeypatch):
             pulls["n"] += 1
             return real_get(x)
 
+        def record(r):
+            if rec is not None and r is not None:
+                rec.record_step(step=r["step"], t=r["t"], dt=r["dt"],
+                                diag=r, sim=sim)
+
         with monkeypatch.context() as m:
             m.setattr(jax, "device_get", counting_get)
             for _ in range(3):
-                diag = guard.step()
-                if rec is not None:
-                    rec.record(sim, diag)
+                record(guard.step())
+            for r in guard.drain():     # the final lagged verdict
+                record(r)
+        assert sim.step_count == 3
         return pulls["n"]
 
     assert run(True) == run(False)
