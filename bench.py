@@ -392,6 +392,63 @@ def run_adaptive(n_warm_steps: int = 40, chain: int = 15):
     }
 
 
+def run_fleet(size: int, members_list, n_steps: int = 40,
+              n_warmup: int = 3):
+    """Fleet-batching throughput curve (fleet.FleetSim): member-steps/s
+    of the DRIVER loop (one fused dispatch + one batched diag pull per
+    step — the product-level stepping cost) at B = 1, 2, 4, 8 on one
+    small grid. Small grids are dispatch-bound — the regime the fleet
+    exists for: stepping B cases in one dispatch amortizes the fixed
+    per-step dispatch+pull overhead over B members, so member-steps/s
+    climbs with B while a single case leaves the device idle. Each
+    member is seeded at its own Taylor-Green amplitude (per-member dt,
+    no lockstep); the warmup runs the executable hot and the window is
+    fenced once with the readback latency subtracted (same methodology
+    as run_size)."""
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.fleet import FleetSim, taylor_green_fleet
+
+    level = int(np.log2(size // 8))
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=1, level_start=0,
+                    extent=1.0, nu=4e-5, cfl=0.5, dtype="float32")
+    points = []
+    for b in members_list:
+        sim = FleetSim(cfg, level=level, members=b)
+        sim.state = taylor_green_fleet(sim.grid, b)
+        sim.step_count = 20    # production regime (skip the exact-mode
+        #                        startup solves — a second executable)
+        for _ in range(n_warmup):
+            sim.step_once()
+        _fence(sim.state.vel)
+        lat = _latency_floor(sim.state.pres)
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            sim.step_once()
+        _fence(sim.state.vel)
+        wall = max(time.perf_counter() - t0 - lat, 1e-9)
+        points.append({
+            "members": b,
+            "step_ms": round(wall / n_steps * 1e3, 3),
+            "member_steps_per_s": round(b * n_steps / wall, 1),
+        })
+    # the headline: dispatch amortization at the largest B, against
+    # the ACTUAL B=1 point (a BENCH_FLEET spec without 1 must not
+    # mislabel a B=2 baseline as B=1 — the field is null then)
+    b1 = next((pt for pt in points if pt["members"] == 1), None)
+    return {
+        "grid": f"{size}x{size}",
+        "steps": n_steps,
+        "points": points,
+        "speedup_vs_b1": (round(
+            points[-1]["member_steps_per_s"]
+            / b1["member_steps_per_s"], 2) if b1 else None),
+        "note": ("member-steps/s of the sync driver loop (one fused "
+                 "dispatch + one batched diag pull per step); the "
+                 "curve IS the dispatch-amortization win — per-member "
+                 "compute is B-invariant"),
+    }
+
+
 def _init_platform() -> str:
     """Initialize an available backend. On boxes without the configured
     accelerator, jax's first device probe dies with RuntimeError
@@ -399,17 +456,42 @@ def _init_platform() -> str:
     rc=1 stack-trace tail in BENCH_*.json (BENCH_r04/r05). Fall back to
     whatever platform initializes (CPU always does) and report it in
     the JSON instead: a bench that says 'platform: cpu' is honest; a
-    crashed bench measures nothing."""
+    crashed bench measures nothing.
+
+    The probe runs a TINY REAL OP, not just jax.devices(): the axon
+    backend registers devices eagerly and defers the actual failure to
+    the first computation (RuntimeError at convert_element_type), so a
+    devices()-only probe passes and the bench then dies at its first
+    jnp call (the BENCH_r05 rc=1 tail). Anything the backend throws —
+    RuntimeError, the bare AssertionError jax 0.4.37 raises for
+    registered-but-deviceless platforms — takes the CPU fallback.
+
+    The fallback must CLEAR the backend cache before retrying: the
+    probe op populates xla_bridge's `_backends`/default-backend cache
+    with the broken platform, and `jax.config.update("jax_platforms")`
+    has no update hook in this jax line — `backends()` early-returns
+    the populated cache, so without the clear the retry dispatches on
+    the same broken backend and dies identically."""
     try:
-        return jax.devices()[0].platform
-    except Exception as e:   # noqa: BLE001 — jax 0.4.37 raises a bare
-        # AssertionError (not RuntimeError) when JAX_PLATFORMS names a
-        # registered platform with no usable device; the bench must
-        # fall back either way
+        return _probe_platform()
+    except Exception as e:   # noqa: BLE001 — see docstring
         print(f"bench: {type(e).__name__}: {e}; falling back to cpu",
               file=sys.stderr)
+        try:
+            from jax.extend.backend import clear_backends
+        except ImportError:   # older spelling
+            from jax._src.xla_bridge import _clear_backends \
+                as clear_backends
+        clear_backends()
         jax.config.update("jax_platforms", "cpu")
-        return jax.devices()[0].platform
+        return _probe_platform()
+
+
+def _probe_platform() -> str:
+    """One real tiny dispatch + the platform name (see _init_platform;
+    module-level so the fallback drill can stub a deferred failure)."""
+    jnp.zeros(1).block_until_ready()
+    return jax.devices()[0].platform
 
 
 def main():
@@ -432,6 +514,19 @@ def main():
                 chain=int(os.environ.get("BENCH_ADAPT_CHAIN", "15")))
         except Exception as e:           # noqa: BLE001 - bench must print
             adaptive = {"error": f"{type(e).__name__}: {e}"}
+    # fleet-batching curve (BENCH_FLEET="1,2,4,8" default; "0" skips;
+    # BENCH_FLEET_SIZE picks the small-grid case — 16^2 default, the
+    # dispatch-bound regime on every platform incl. the CPU CI box)
+    fleet = None
+    fleet_spec = os.environ.get("BENCH_FLEET", "1,2,4,8")
+    if fleet_spec not in ("", "0"):
+        try:
+            fleet = run_fleet(
+                int(os.environ.get("BENCH_FLEET_SIZE", "16")),
+                [int(b) for b in fleet_spec.split(",") if b],
+                n_steps=int(os.environ.get("BENCH_FLEET_STEPS", "40")))
+        except Exception as e:           # noqa: BLE001 - bench must print
+            fleet = {"error": f"{type(e).__name__}: {e}"}
 
     # PRIMARY metric: DEVICE-derived throughput (profiler module time
     # over chained steps). The fenced-wall number carries host/tunnel
@@ -495,6 +590,8 @@ def main():
     }
     if adaptive:
         out["adaptive_canonical"] = adaptive
+    if fleet:
+        out["fleet"] = fleet
     if secondary:
         out["secondary"] = secondary
     print(json.dumps(out))

@@ -14,7 +14,15 @@ By default this executes the adaptive (AMR) path, exactly like the
 reference. Extra flags beyond the reference: ``-level N`` (force a
 single-resolution uniform run at level N), ``-dtype``, ``-output DIR``,
 ``-checkpointEvery N``, ``-restart DIR``, ``-maxSteps N``, ``-profile``
-(per-phase timer report + cells*steps/s at exit).
+(per-phase timer report + cells*steps/s at exit), and ``-fleet B``
+(fleet batching, fleet.py: advance B independent obstacle-free uniform
+cases in ONE fused dispatch — per-member device dt/clocks, one batched
+diag pull for the whole fleet, per-member supervision via
+FleetStepGuard, per-member telemetry in schema v3. The t=0 state is an
+amplitude-laddered Taylor-Green ensemble so every member runs at its
+own CFL dt; dumps write one reference-format triplet per member,
+``vel.NNNNNNNN.mK``. Obstacle-free only: ``-shapes`` with ``-fleet``
+is an error).
 
 The run loop is SUPERVISED (resilience.py): every step's health verdict
 rides the diagnostics the step already pulls, a bad step walks the
@@ -65,7 +73,8 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     p = CommandlineParser(argv)
     cfg = SimConfig.from_argv(argv)
-    uniform = p.has("level") or cfg.level_max <= 1
+    fleet_n = p("fleet").asInt() if p.has("fleet") else 0
+    uniform = fleet_n > 0 or p.has("level") or cfg.level_max <= 1
     outdir = p("output").asString() if p.has("output") else "."
     ckpt_every = p("checkpointEvery").asInt() if p.has("checkpointEvery") \
         else 0
@@ -74,8 +83,8 @@ def main(argv=None) -> int:
 
     from . import faults
     from .profiling import HostCounters, MetricsRecorder, TraceWindow
-    from .resilience import EventLog, PhysicsWatchdog, PreemptionGuard, \
-        ResilienceAbort, StepGuard, set_event_log
+    from .resilience import EventLog, FleetStepGuard, PhysicsWatchdog, \
+        PreemptionGuard, ResilienceAbort, StepGuard, set_event_log
 
     plan = faults.FaultPlan.from_env()   # CUP2D_FAULTS, latched once
     faults.install(plan)                 # io.py's crash window consults it
@@ -85,7 +94,20 @@ def main(argv=None) -> int:
     set_event_log(log)                   # io/launch fallback events
     tracer = TraceWindow.from_env()      # CUP2D_TRACE, latched once
 
-    if uniform:
+    if fleet_n:
+        if cfg.shapes:
+            print("cup2d_tpu: -fleet supports obstacle-free uniform "
+                  "runs only (shapes given)", file=sys.stderr)
+            return 2
+        from .fleet import FleetSim
+        level = p("level").asInt() if p.has("level") else cfg.level_start
+        sim = FleetSim(cfg, level=level, members=fleet_n)
+        if not p.has("restart"):
+            # obstacle-free zero state would be a trivial run: seed the
+            # amplitude-laddered Taylor-Green ensemble (per-member umax
+            # -> per-member dt, the no-lockstep contract live)
+            sim.seed_taylor_green()
+    elif uniform:
         from .sim import Simulation
         level = p("level").asInt() if p.has("level") else cfg.level_start
         sim = Simulation(cfg, level=level)
@@ -98,11 +120,12 @@ def main(argv=None) -> int:
         from .profiling import PhaseTimers
         sim.timers = PhaseTimers()
 
-    force_path = os.path.join(outdir, "forces.csv")
-    resuming = p.has("restart") and os.path.exists(force_path)
-    sim.force_log = open(force_path, "a" if resuming else "w")
-    if not resuming:
-        sim.force_log.write(type(sim).force_log_header() + "\n")
+    if not fleet_n:
+        force_path = os.path.join(outdir, "forces.csv")
+        resuming = p.has("restart") and os.path.exists(force_path)
+        sim.force_log = open(force_path, "a" if resuming else "w")
+        if not resuming:
+            sim.force_log.write(type(sim).force_log_header() + "\n")
 
     if sim.shapes and not p.has("restart"):
         # t=0 only: the chi-blend vel = vel(1-chi) + udef*chi would
@@ -112,14 +135,21 @@ def main(argv=None) -> int:
         sim.initialize()   # so the t=0 dump sees the blended velocity
 
     def dump(path):
-        if uniform:
+        if fleet_n:
+            # one reference-format triplet per member, at the MEMBER's
+            # own clock (sim.time is only the fleet min)
+            for m in range(sim.members):
+                dump_uniform(f"{path}.m{m}", float(sim.times[m]),
+                             sim.state.vel[m], sim.grid.h)
+        elif uniform:
             dump_uniform(path, sim.time, sim.state.vel, sim.grid.h)
         else:
             sim.sync_fields()
             dump_forest(path, sim.time, sim.forest)
 
     ckpt_path = os.path.join(outdir, "checkpoint")
-    guard = StepGuard(
+    guard_cls = FleetStepGuard if fleet_n else StepGuard
+    guard = guard_cls(
         sim,
         ring=p("guardRing").asInt() if p.has("guardRing") else 1,
         ckpt_dir=ckpt_path,
@@ -194,7 +224,11 @@ def main(argv=None) -> int:
                     drain()
                     continue
                 break
-            if stop.triggered:
+            # agree() is a min-allreduce of the SIGTERM latch on pods
+            # (all hosts enter the collective save at the same step —
+            # the former ROADMAP pod gap (a)); single-host it is just
+            # the local flag
+            if stop.agree():
                 drain()
                 save_checkpoint(ckpt_path, sim)
                 log.emit(event="sigterm_checkpoint", step=sim.step_count,

@@ -128,7 +128,7 @@ class FaultPlan:
             self._suspended -= 1
 
     # -- hooks consulted by the guard / io ----------------------------
-    def apply_pre_step(self, sim) -> list:
+    def apply_pre_step(self, sim, step: Optional[int] = None) -> list:
         """Poison or scale the velocity before an attempt of the
         current step. Returns the consumed [value, count] entries
         (truthy when anything fired) so the StepGuard can REFUND a
@@ -136,16 +136,21 @@ class FaultPlan:
         dispatched on top of a not-yet-detected bad step is thrown
         away and re-dispatched after recovery — a fault armed for it
         must fire at the real dispatch, not be eaten by the garbage
-        one."""
+        one. ``step`` overrides the counter lookup: the fleet guard's
+        per-member retry re-attempts step N while the SHARED fleet
+        counter already sits at N+1 (member recovery never rewinds the
+        counter), so it must name the step it is retrying."""
         if self._suspended:
             return []
+        if step is None:
+            step = sim.step_count
         fired = []
-        ent = self.vel_poison.get(sim.step_count)
+        ent = self.vel_poison.get(step)
         if ent and ent[1] > 0:
             ent[1] -= 1
             poison_velocity(sim, ent[0])
             fired.append(ent)
-        ent = self.vel_scale.get(sim.step_count)
+        ent = self.vel_scale.get(step)
         if ent and ent[1] > 0:
             ent[1] -= 1
             scale_velocity(sim, ent[0])
@@ -181,25 +186,39 @@ def poison_velocity(sim, value: float) -> None:
     """Write ``value`` into one velocity cell of a REAL block/cell
     through each driver's supported write path (the ordered working
     state on the forest — slot writes between steps would trip the
-    _ord_dirty guard; the FlowState on the uniform drivers)."""
+    _ord_dirty guard; the FlowState on the uniform drivers). On a
+    FLEET state ([B, 2, Ny, Nx], fleet.FleetSim) only MEMBER 0 is
+    poisoned — the per-member recovery drill: the guard must rewind
+    only that member while the others' trajectories stay
+    bit-identical."""
     if hasattr(sim, "forest"):
         ordf = sim._ordered_state()
         sim._set_ordered(vel=ordf["vel"].at[0, 0, 0, 0].set(value))
     else:
-        sim.state = sim.state._replace(
-            vel=sim.state.vel.at[0, 0, 0].set(value))
+        vel = sim.state.vel
+        if vel.ndim == 4:   # fleet [B, 2, Ny, Nx]: member 0 only
+            sim.state = sim.state._replace(
+                vel=vel.at[0, 0, 0, 0].set(value))
+        else:
+            sim.state = sim.state._replace(
+                vel=vel.at[0, 0, 0].set(value))
 
 
 def scale_velocity(sim, factor: float) -> None:
     """Multiply the whole velocity field by ``factor`` — every value
     stays finite (the wrong-but-finite corruption class the isfinite
     verdict cannot see), through the same supported write paths as
-    :func:`poison_velocity`."""
+    :func:`poison_velocity` (member 0 only on a fleet)."""
     if hasattr(sim, "forest"):
         ordf = sim._ordered_state()
         sim._set_ordered(vel=ordf["vel"] * factor)
     else:
-        sim.state = sim.state._replace(vel=sim.state.vel * factor)
+        vel = sim.state.vel
+        if vel.ndim == 4:   # fleet: corrupt member 0, leave the rest
+            sim.state = sim.state._replace(
+                vel=vel.at[0].set(vel[0] * factor))
+        else:
+            sim.state = sim.state._replace(vel=vel * factor)
 
 
 # -- process-wide plan (the CLI arms it; io.py's crash window asks) ---

@@ -1,0 +1,356 @@
+"""Fleet batching: B independent uniform cases in ONE fused dispatch.
+
+Every entry point before this module stepped exactly one case per
+process, so small/medium grids leave the device dispatch-bound (the
+BASELINE.md adaptive step is tunnel-latency bound at 0.218 s warm vs
+21.5 ms device time). Batching independent cases onto one device is the
+classic inference-stack throughput lever, and the codebase is shaped
+for it: the step core is pure and trivially batchable (every stencil op
+in ops/stencil.py is leading-dim agnostic), dt chains on device, and
+the diag pull is already one batched ``device_get``. Multi-case
+throughput is the same axis AMReX exploits for multiphysics fleets
+(arXiv:2009.12009) and the FFT multi-block solver exploits for
+massively parallel runs (arXiv:2106.03583).
+
+:class:`FleetSim` advances B independent obstacle-free ``UniformSim``
+cases per dispatch:
+
+- the state is one ``FlowState`` with a leading member axis
+  ``[B, ...]``; the whole Heun + penalization-free projection step is
+  ONE jitted executable regardless of B;
+- each member integrates at ITS OWN dt — no lockstep: dt is a ``[B]``
+  device vector chained on device from each member's end-state umax,
+  and the per-member clocks live in ``times`` (host, settled through
+  the same one batched diag pull the single-case drivers pay);
+- the pressure solves of all members run in ONE fused Krylov loop
+  (``poisson.bicgstab(member_axis=True)``): per-member convergence
+  mask, predicate = any member unconverged, converged members frozen
+  via select so the extra sweeps are bit-exact identity for them;
+- supervision is per-member (``resilience.FleetStepGuard``): a bad
+  member restores ONLY its slice of the device snapshot ring and
+  replays solo through :meth:`FleetSim.member_step_once`; healthy
+  members never rewind.
+
+Contract with the single-case driver (tests/test_fleet.py):
+``FleetSim`` with B = 1 is BIT-IDENTICAL to ``UniformSim`` — same
+trajectory, equal ``device_get`` counts. For B > 1 each member's
+trajectory matches its solo run to <= 1e-12: the advection, projection
+and every reduction (umax/energy/Krylov dots) are bit-exact per member
+(measured — per-member reductions over ``[B, Ny, Nx]`` reduce the same
+elements in the same order as the solo form), but the multigrid
+V-cycle's fused elementwise sweep chains compile with different
+FMA-contraction choices for member-batched operands (LLVM
+vectorization over the leading axis), deviating ~1 ulp per sweep.
+Flexible BiCGSTAB absorbs preconditioner inexactness by construction,
+so the per-step trajectory deviation stays at ~1e-16..1e-13, the
+short warm-start production solves keep IDENTICAL per-member iteration
+counts and solver health, and the per-member clock can differ from the
+solo clock by at most an ulp per step (the state deviation perturbing
+the umax cell's last bit perturbs dt_next's) — pinned by
+``tests/test_fleet.py::test_fleet_members_match_solo_runs`` (production
+regime — warm deltap guesses, short solves). Long ROUGH solves (~50+
+iterations on O(1) residuals) can compound the rounding into a
+different — equally converged — Krylov path, so batched-vs-solo
+agreement there is at the solve's own convergence target rather than
+1e-12; the frozen-member invariance (the select mask) is exact
+regardless and pinned separately.
+
+Sharding composes (``mesh=``): when the per-member grid is small,
+WHOLE MEMBERS are placed along the existing ``"x"`` mesh axis
+(member-parallel — each member's stencils and reductions stay
+shard-local, zero per-step halo collectives); big grids fall back to
+the spatial x-split of ``ShardedUniformSim`` (with its spmd_safe
+stencil forms), where the member axis rides along replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import SimConfig
+from .ops.stencil import (
+    advect_diffuse_rhs,
+    divergence_freeslip,
+    dt_from_umax,
+    laplacian5_neumann,
+    pressure_gradient_update_fused,
+)
+from .poisson import bicgstab
+from .uniform import FlowState, UniformGrid, pad_vector, taylor_green_state
+
+
+def stack_states(states) -> FlowState:
+    """Stack per-member FlowStates into one fleet state [B, ...]."""
+    return FlowState(*(jnp.stack(list(leaves))
+                       for leaves in zip(*states)))
+
+
+def taylor_green_fleet(grid, members: int, amp0: float = 1.0,
+                       decay: float = 0.8) -> FlowState:
+    """A B-member ensemble of Taylor-Green vortices at geometrically
+    decaying amplitudes (member m scaled by ``amp0 * decay**m``): the
+    canonical obstacle-free validation case, with per-member umax — so
+    every member runs at its OWN CFL dt and the no-lockstep contract is
+    exercised for real (identical members would hide a lockstep bug)."""
+    base = taylor_green_state(grid)
+    return stack_states([
+        base._replace(vel=base.vel * (amp0 * decay ** m))
+        for m in range(members)])
+
+
+class FleetSim:
+    """Host-side driver for a B-member fleet: owns the shared step
+    counter and per-member clocks, jits the fused member-batched step.
+
+    Mirrors the ``UniformSim`` driver contract (``step_once`` /
+    ``async_diag`` / ``_force_exact`` / ``_next_dt``) so the StepGuard
+    machinery drives it unchanged — except the diag scalars are [B]
+    vectors and the guard generalizes the verdict per member
+    (resilience.FleetStepGuard).
+
+    Placement (``mesh=``): ``placement="member"`` shards the leading
+    member axis over the mesh (small grids — every member's compute is
+    shard-local), ``"spatial"`` shards the x-axis like
+    ``ShardedUniformSim`` (big grids), ``"auto"`` picks member-parallel
+    when B divides the mesh and the per-member grid fits
+    ``member_cells_cap`` cells, else spatial.
+    """
+
+    def __init__(self, cfg: SimConfig, level: Optional[int] = None,
+                 members: int = 1, mesh=None, placement: str = "auto",
+                 member_cells_cap: int = 1 << 22):
+        if members < 1:
+            raise ValueError(f"need members >= 1, got {members}")
+        self.cfg = cfg
+        self.members = int(members)
+        self.mesh = mesh
+        lvl = cfg.level_start if level is None else level
+        nx = cfg.bpdx * cfg.bs << lvl
+        ny = cfg.bpdy * cfg.bs << lvl
+        if mesh is not None:
+            ndev = mesh.devices.size
+            if placement == "auto":
+                placement = ("member"
+                             if members % ndev == 0
+                             and nx * ny <= member_cells_cap
+                             else "spatial")
+            if placement == "member" and members % ndev != 0:
+                raise ValueError(
+                    f"member placement needs members ({members}) "
+                    f"divisible by mesh size {ndev}")
+            if placement == "spatial" and nx % ndev != 0:
+                raise ValueError(
+                    f"spatial placement needs Nx={nx} divisible by "
+                    f"mesh size {ndev}")
+        else:
+            placement = "single"
+        self.placement = placement
+        # spmd_safe only where spatial axes are actually sharded: the
+        # member-parallel layout keeps every member's stencil axes
+        # whole on one device, so the fast zero-shift form is safe
+        self.grid = UniformGrid(cfg, level, spmd_safe=(placement == "spatial"))
+        g = self.grid
+        self.state = stack_states([g.zero_state()
+                                   for _ in range(self.members)])
+        self.times = np.zeros(self.members, dtype=np.float64)
+        self.time = 0.0           # min over members (the loop condition)
+        self.step_count = 0       # shared: one dispatch = one step for all
+        self.shapes: list = []    # obstacle-free by construction
+        self.timers = None
+        self.force_log = None
+        self._next_dt = None      # [B] device vector (end-state dt_next)
+        self._force_exact = False
+        self.async_diag = False
+        out_shardings = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            if placement == "member":
+                sv = NamedSharding(mesh, P("x", None, None, None))
+                ss = NamedSharding(mesh, P("x", None, None))
+            else:
+                sv = NamedSharding(mesh, P(None, None, None, "x"))
+                ss = NamedSharding(mesh, P(None, None, "x"))
+            shardings = FlowState(vel=sv, pres=ss, chi=ss, us=sv, udef=sv)
+            self.state = FlowState(*(jax.device_put(a, s) for a, s
+                                     in zip(self.state, shardings)))
+            out_shardings = (shardings, None)
+        self._step = jax.jit(
+            self._step_impl, donate_argnums=(0,),
+            static_argnames=("exact_poisson",),
+            **({"out_shardings": out_shardings}
+               if out_shardings is not None else {}))
+        self._dt = jax.jit(self._dt_impl)
+        # single-member core for the guard's per-member rewind/replay
+        # (the cold path): the SAME pure step the solo driver jits, on
+        # one member's slice
+        self._member_step = jax.jit(
+            g.step, donate_argnums=(0,),
+            static_argnames=("exact_poisson", "obstacle_terms"))
+        self._member_dt = jax.jit(g.compute_dt)
+
+    # -- fused member-batched step core -------------------------------
+    def _dt_impl(self, vel: jnp.ndarray) -> jnp.ndarray:
+        """Per-member CFL dt [B] from the fleet velocity [B,2,Ny,Nx]."""
+        g = self.grid
+        umax = jnp.max(jnp.abs(vel), axis=(-3, -2, -1))
+        return dt_from_umax(umax, jnp.asarray(g.h, g.dtype),
+                            g.cfg.nu, g.cfg.cfl)
+
+    def _pressure_solve(self, rhs: jnp.ndarray, exact: bool):
+        """Member-batched ``UniformGrid.pressure_solve``: same
+        tolerances/refresh/stall policy, ONE fused Krylov loop with the
+        per-member convergence mask (poisson.bicgstab member_axis)."""
+        g = self.grid
+        cfg = self.cfg
+        return bicgstab(
+            g.laplacian,
+            rhs,
+            M=g.mg if cfg.precond else None,
+            tol=0.0 if exact else cfg.poisson_tol,
+            tol_rel=0.0 if exact else cfg.poisson_tol_rel,
+            max_iter=cfg.max_poisson_iterations,
+            max_restarts=100 if exact else cfg.max_poisson_restarts,
+            sum_dtype=g.sum_dtype,
+            refresh_every=10 if exact else 50,
+            stall_iters=20 if exact else 120,
+            stall_rtol=0.99 if exact else 0.999,
+            member_axis=True,
+        )
+
+    def _step_impl(self, state: FlowState, dt: jnp.ndarray,
+                   exact_poisson: bool = False):
+        """One fused step of every member: Heun advection-diffusion +
+        deltap projection (obstacle-free — the identically-zero
+        penalization/chi terms are statically dropped, like
+        ``UniformGrid.step(obstacle_terms=False)``). ``dt`` is [B]."""
+        g = self.grid
+        h = g.h
+        ih2 = 1.0 / (h * h)
+        dt3 = dt[:, None, None]            # broadcast vs [B, Ny, Nx]
+        dt4 = dt[:, None, None, None]      # broadcast vs [B, 2, Ny, Nx]
+
+        # -- advection-diffusion, 2-stage Heun (per-member dt) --
+        vel = state.vel
+        vold = vel
+        for c in (0.5, 1.0):
+            lab = pad_vector(vel, 3)
+            rhs = advect_diffuse_rhs(lab, 3, h, g.cfg.nu, dt4)
+            vel = vold + c * rhs * ih2
+
+        # -- deltap pressure projection (chi == 0) --
+        b = (0.5 * h / dt3) * divergence_freeslip(vel, g.spmd_safe)
+        div_linf = jnp.max(jnp.abs(b), axis=(-2, -1)) * (dt / (h * h))
+        b = b - laplacian5_neumann(state.pres, g.spmd_safe)
+        res = self._pressure_solve(b, exact_poisson)
+        dp = res.x - jnp.mean(res.x, axis=(-2, -1), keepdims=True)
+        pres = dp + state.pres - jnp.mean(state.pres, axis=(-2, -1),
+                                          keepdims=True)
+        dv = pressure_gradient_update_fused(pres, h, dt4, g.spmd_safe)
+        vel = vel + dv * ih2
+
+        # -- per-member diag (the one batched pull's payload) --
+        umax = jnp.max(jnp.abs(vel), axis=(-3, -2, -1))
+        vv = vel.astype(g.sum_dtype) if g.sum_dtype is not None else vel
+        energy = 0.5 * h * h * jnp.sum(vv * vv, axis=(-3, -2, -1))
+        finite = (jnp.all(jnp.isfinite(vel), axis=(-3, -2, -1))
+                  & jnp.all(jnp.isfinite(pres), axis=(-2, -1)))
+        diag = {
+            "poisson_iters": res.iters,
+            "poisson_residual": res.residual,
+            "poisson_stalled": res.stalled,
+            "poisson_converged": res.converged,
+            "finite": finite,
+            "umax": umax,
+            "energy": energy,
+            "div_linf": div_linf,
+            "dt_next": dt_from_umax(umax, jnp.asarray(h, g.dtype),
+                                    g.cfg.nu, g.cfg.cfl),
+        }
+        return state._replace(vel=vel, pres=pres), diag
+
+    # -- driver contract (StepGuard-compatible) -----------------------
+    def step_once(self, dt=None):
+        """One fused fleet step. ``dt``: None (chained per-member
+        device dt), a scalar (all members), or a [B] vector. One
+        batched diag pull per step for the WHOLE fleet — or none under
+        ``async_diag`` (the guard's lagged verdict pulls it)."""
+        g = self.grid
+        if dt is None:
+            dt = (self._next_dt if self._next_dt is not None
+                  else self._dt(self.state.vel))
+        dt_dev = jnp.asarray(dt, g.dtype)
+        if dt_dev.ndim == 0:
+            dt_dev = jnp.full((self.members,), dt_dev, g.dtype)
+        exact = self.step_count < 10 or self._force_exact
+        timers = self.timers
+        if timers is None:
+            from .profiling import NULL_TIMERS
+            timers = NULL_TIMERS
+        with timers.phase("step"):
+            self.state, diag = self._step(self.state, dt_dev,
+                                          exact_poisson=exact)
+            diag = dict(diag)
+            diag["dt"] = dt_dev   # rides the one pull (per-member clocks)
+            self._next_dt = diag["dt_next"]
+            if self.async_diag:
+                # -profile must still attribute device time to the
+                # phase (fence = the documented cost of profiling, as
+                # on the other drivers); the no-timers path stays
+                # fence-free
+                timers.fence("step", self.state.vel)
+                self.step_count += 1
+                return diag
+            diag = jax.device_get(diag)   # the natural phase fence
+        self.times = self.times + np.asarray(diag["dt"], np.float64)
+        self.time = float(self.times.min())
+        self.step_count += 1
+        return diag
+
+    # -- per-member access (the guard's slice rewind path) ------------
+    def member_state(self, m: int) -> FlowState:
+        """Member ``m``'s slice as a solo FlowState (fresh arrays)."""
+        return FlowState(*(a[m] for a in self.state))
+
+    def set_member_state(self, m: int, st: FlowState) -> None:
+        """Install a solo FlowState into member ``m``'s slice; every
+        other member's values pass through bit-unchanged."""
+        self.state = FlowState(*(a.at[m].set(v)
+                                 for a, v in zip(self.state, st)))
+
+    def set_member_next_dt(self, m: int, dt_next) -> None:
+        if self._next_dt is not None:
+            self._next_dt = jnp.asarray(self._next_dt).at[m].set(
+                jnp.asarray(dt_next, self.grid.dtype))
+
+    def member_step_once(self, m: int, dt=None, exact: bool = False):
+        """Advance ONLY member ``m`` one step through the solo
+        single-member executable (the guard's replay/retry path —
+        recovery is the cold path; the fused dispatch is the hot one).
+        Leaves the shared step counter, the fleet dt cache and the
+        clocks untouched: the caller (FleetStepGuard) owns those.
+        Returns the solo diag dict (device scalars)."""
+        st = self.member_state(m)
+        if dt is None:
+            dt = float(self._member_dt(st.vel))
+        st, diag = self._member_step(
+            st, jnp.asarray(dt, self.grid.dtype),
+            exact_poisson=bool(exact), obstacle_terms=False)
+        self.set_member_state(m, st)
+        diag = dict(diag)
+        diag["dt"] = float(dt)
+        return diag
+
+    def seed_taylor_green(self, amp0: float = 1.0,
+                          decay: float = 0.8) -> None:
+        """Seed the amplitude-laddered Taylor-Green ensemble (the CLI
+        fleet mode's t=0 state: obstacle-free zero state would make a
+        trivial run; the ladder gives every member its own umax/dt)."""
+        st = taylor_green_fleet(self.grid, self.members, amp0, decay)
+        if self.mesh is not None:
+            st = FlowState(*(jax.device_put(np.asarray(a), b.sharding)
+                             for a, b in zip(st, self.state)))
+        self.state = st

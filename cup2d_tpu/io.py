@@ -231,6 +231,11 @@ def _gather_state(sim):
         "config": {k: v for k, v in vars(sim.cfg).items()
                    if not k.startswith("_")},
     }
+    if hasattr(sim, "times"):
+        # fleet driver (fleet.FleetSim): per-member clocks must survive
+        # the checkpoint — sim.time is only their min
+        meta["fleet"] = {"members": int(sim.members),
+                         "times": [float(t) for t in sim.times]}
     if hasattr(sim, "forest") and hasattr(sim, "_next_dt"):
         # the cached next-dt state must SURVIVE the checkpoint, or a
         # restart right after a regrid takes compute_dt's post-regrid
@@ -437,6 +442,19 @@ def _install_state(sim, data, meta: dict, shapes) -> None:
         sim._coarse_on = bool(trig["coarse_on"])
         sim._last_iters = int(trig["last_iters"])
         sim._last_iters_dev = None
+    fl = meta.get("fleet")
+    if hasattr(sim, "times"):
+        if fl is not None:
+            if int(fl["members"]) != int(sim.members):
+                raise ValueError(
+                    f"checkpoint holds {fl['members']} fleet members, "
+                    f"sim has {sim.members}")
+            sim.times = np.asarray(fl["times"], np.float64)
+        else:
+            # pre-fleet checkpoint restored into a fleet: every member
+            # inherits the shared clock
+            sim.times = np.full(sim.members, float(meta["time"]))
+        sim.time = float(sim.times.min())
     if hasattr(sim, "shapes") and shapes is not None:
         sim.shapes[:] = shapes
         sim._initialized = True  # fields already hold the blended state
@@ -534,6 +552,11 @@ def snapshot_state_device(sim) -> "DeviceSnapshot":
         payload = {k: device_copy(v)
                    for k, v in sim.state._asdict().items()}
         meta["kind"] = "uniform"
+        if hasattr(sim, "times"):
+            # fleet: per-member clocks ride the snapshot (host numpy —
+            # the FleetStepGuard settles them at verdict time exactly
+            # like the scalar clock)
+            meta["times"] = np.array(sim.times)
         _split_cache(meta, dev, "next_dt", getattr(sim, "_next_dt", None))
     shapes = getattr(sim, "shapes", None)
     return DeviceSnapshot(
@@ -628,6 +651,9 @@ def restore_snapshot_device(sim, snap: DeviceSnapshot) -> None:
     else:
         sim.time = float(meta["time"])
         sim.step_count = int(meta["step_count"])
+        if hasattr(sim, "times") and "times" in meta:
+            sim.times = np.array(meta["times"])
+            sim.time = float(sim.times.min())
         sim.state = type(sim.state)(
             **{k: device_copy(v) for k, v in snap.payload.items()})
         _restore_cache(sim, snap)

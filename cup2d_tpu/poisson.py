@@ -169,12 +169,14 @@ class MultigridPreconditioner:
         # trailing dims pad to the (8,128) TPU tile: 4 GB of temporaries
         # at 4096^2) nor a 4-way doubly-strided slice sum (measured
         # 1.8 s at 8192^2) — the two-stage form keeps each slice
-        # single-strided and runs at the latency floor.
-        rows = res[0::2, :] + res[1::2, :]
-        rc = rows[:, 0::2] + rows[:, 1::2]
+        # single-strided and runs at the latency floor. `...` indexing:
+        # the cycle is leading-dim agnostic so the fleet path can run
+        # one V-cycle over a whole [B, Ny, Nx] member batch.
+        rows = res[..., 0::2, :] + res[..., 1::2, :]
+        rc = rows[..., :, 0::2] + rows[..., :, 1::2]
         ec = self._cycle(rc, lvl + 1)
         # nearest prolongation (2x2 replicate)
-        e = e + jnp.repeat(jnp.repeat(ec, 2, axis=0), 2, axis=1)
+        e = e + jnp.repeat(jnp.repeat(ec, 2, axis=-2), 2, axis=-1)
         return self._smooth(e, r, lvl, self.nu2)
 
 
@@ -272,7 +274,7 @@ class _State(NamedTuple):
     rho: jnp.ndarray
     alpha: jnp.ndarray
     omega: jnp.ndarray
-    it: jnp.ndarray
+    it: jnp.ndarray          # global loop counter (scalar)
     restarts: jnp.ndarray
     x_opt: jnp.ndarray
     norm_opt: jnp.ndarray
@@ -280,6 +282,7 @@ class _State(NamedTuple):
     best_it: jnp.ndarray
     best_l2: jnp.ndarray
     impr_it: jnp.ndarray
+    it_m: jnp.ndarray        # per-member iteration count (== it unbatched)
     done: jnp.ndarray
 
 
@@ -296,6 +299,7 @@ def bicgstab(
     refresh_every: int = 50,
     stall_iters: int = 120,
     stall_rtol: float = 0.999,
+    member_axis: bool = False,
 ) -> BiCGSTABResult:
     """Preconditioned flexible BiCGSTAB, whole loop jitted on device.
 
@@ -325,17 +329,38 @@ def bicgstab(
     ~40 iterations), so any restart policy keyed on Linf improvement
     livelocks by restarting from x0 forever. Costs one extra operator
     application per refresh (lax.cond — not per iteration).
+
+    ``member_axis`` (the fleet path, fleet.py): ``b`` carries a leading
+    MEMBER axis of B independent systems solved in one fused loop. Every
+    reduction becomes per-member (axes 1..; Krylov scalars are [B,1,..]
+    broadcastables), convergence is a per-member ``done`` mask, the
+    while-loop predicate is "any member unconverged", and a converged
+    member's ENTIRE iteration state is frozen via select — the extra
+    sweeps the loop runs for the slowest member are bit-exact identity
+    for the converged ones, so each member's solution equals its solo
+    solve (tests/test_fleet.py pins this). ``iters``/``residual``/
+    ``converged``/``stalled`` come back per-member [B].
     """
     if M is None:
         M = lambda v: v
     dt_ = b.dtype
     sd = sum_dtype or dt_
 
-    def dot(a_, b_):
-        return jnp.sum((a_ * b_).astype(sd)).astype(dt_)
+    if member_axis:
+        raxes = tuple(range(1, b.ndim))
 
-    def linf(a_):
-        return jnp.max(jnp.abs(a_))
+        def dot(a_, b_):
+            return jnp.sum((a_ * b_).astype(sd), axis=raxes,
+                           keepdims=True).astype(dt_)
+
+        def linf(a_):
+            return jnp.max(jnp.abs(a_), axis=raxes, keepdims=True)
+    else:
+        def dot(a_, b_):
+            return jnp.sum((a_ * b_).astype(sd)).astype(dt_)
+
+        def linf(a_):
+            return jnp.max(jnp.abs(a_))
 
     if x0 is None:
         # A is linear (a Laplacian), so A(0) = 0: starting from zero
@@ -347,25 +372,33 @@ def bicgstab(
         r0 = b - A(x0)
     norm0 = linf(r0)
     target = jnp.maximum(jnp.asarray(tol, dt_), tol_rel * norm0)
-    one = jnp.asarray(1.0, dt_)
+    # per-member-shaped constants under member_axis ([B,1,..], so the
+    # while-loop carry shapes are stable); plain scalars otherwise
+    one = jnp.ones_like(norm0)
+    i0 = jnp.zeros_like(norm0, dtype=jnp.int32) if member_axis \
+        else jnp.asarray(0, jnp.int32)
 
     init = _State(
         x=x0, r=r0, rhat=r0, p=jnp.zeros_like(b), v=jnp.zeros_like(b),
         rho=one, alpha=one, omega=one,
-        it=jnp.asarray(0, jnp.int32), restarts=jnp.asarray(0, jnp.int32),
+        it=jnp.asarray(0, jnp.int32), restarts=i0,
         x_opt=x0, norm_opt=norm0, norm0=norm0,
-        best_it=jnp.asarray(0, jnp.int32),
+        best_it=i0,
         best_l2=jnp.sqrt(dot(r0, r0)),
-        impr_it=jnp.asarray(0, jnp.int32),
+        impr_it=i0,
+        it_m=i0,
         done=norm0 <= target,
     )
 
     breakdown_eps = jnp.asarray(1e-21 if dt_ == jnp.float64 else 1e-30, dt_)
 
     def cond(s: _State):
-        return (~s.done) & (s.it < max_iter)
+        # member_axis: run while ANY member is unconverged (each member
+        # freezes independently in the body below)
+        return jnp.any(~s.done) & (s.it < max_iter)
 
     def body(s: _State):
+        frozen = s.done   # members already converged at loop entry
         rho_probe = dot(s.rhat, s.r)
         # serious breakdown -> restart with rhat = r (cuda.cu:457-477)
         norm_r = jnp.sqrt(dot(s.r, s.r))
@@ -375,6 +408,14 @@ def bicgstab(
         )
         can_restart = s.restarts < max_restarts
         refresh = (s.it - s.best_it) >= refresh_every
+        if member_axis:
+            # a frozen member's best_it stops moving while the global
+            # it keeps climbing, so its refresh flag would latch true
+            # and force the expensive true-residual cond branch on
+            # every remaining iteration of the fused loop — for state
+            # the freeze discards anyway. Mask it: only ACTIVE members
+            # request refreshes.
+            refresh = refresh & ~frozen
         do_restart = (breakdown & can_restart) | refresh
         give_up = breakdown & ~can_restart & ~refresh
 
@@ -394,11 +435,27 @@ def bicgstab(
                     jnp.where(take_x, s.x, s.x_opt),
                     jnp.where(take_x, n_true, n_opt_true))
 
-        r, x_opt0, norm_opt0 = jax.lax.cond(
-            refresh,
-            refreshed,
-            lambda: (s.r, s.x_opt, s.norm_opt),
-        )
+        if member_axis:
+            # refresh is a per-member vector: pay the true-residual
+            # operator applications only when ANY member refreshes, and
+            # select per member inside
+            def refreshed_m():
+                r_t, xo_t, no_t = refreshed()
+                return (jnp.where(refresh, r_t, s.r),
+                        jnp.where(refresh, xo_t, s.x_opt),
+                        jnp.where(refresh, no_t, s.norm_opt))
+
+            r, x_opt0, norm_opt0 = jax.lax.cond(
+                jnp.any(refresh),
+                refreshed_m,
+                lambda: (s.r, s.x_opt, s.norm_opt),
+            )
+        else:
+            r, x_opt0, norm_opt0 = jax.lax.cond(
+                refresh,
+                refreshed,
+                lambda: (s.r, s.x_opt, s.norm_opt),
+            )
         rhat = jnp.where(do_restart, r, s.rhat)
         rho_new = jnp.where(do_restart, dot(rhat, r), rho_probe)
         beta = jnp.where(
@@ -444,7 +501,7 @@ def bicgstab(
         # only breakdown-triggered restarts consume the reference's
         # max_restarts budget; periodic refreshes are unbudgeted.
         # best_it here records the last refresh iteration.
-        return _State(
+        new = _State(
             x=x, r=r, rhat=rhat, p=p, v=v,
             rho=rho_new, alpha=alpha, omega=omega,
             it=s.it + 1,
@@ -453,19 +510,48 @@ def bicgstab(
             best_it=jnp.where(do_restart, s.it, s.best_it),
             best_l2=best_l2,
             impr_it=impr_it,
+            it_m=s.it_m + 1,
             done=done,
+        )
+        if not member_axis:
+            return new
+        # per-member convergence mask: a member that was done at loop
+        # entry FREEZES its entire iteration state — the sweeps the
+        # loop keeps running for slower members are exact identity for
+        # it, so its solution is bit-equal to its solo solve
+        keep = lambda old, cur: jnp.where(frozen, old, cur)
+        return _State(
+            x=keep(s.x, new.x), r=keep(s.r, new.r),
+            rhat=keep(s.rhat, new.rhat), p=keep(s.p, new.p),
+            v=keep(s.v, new.v), rho=keep(s.rho, new.rho),
+            alpha=keep(s.alpha, new.alpha), omega=keep(s.omega, new.omega),
+            it=new.it,
+            restarts=keep(s.restarts, new.restarts),
+            x_opt=keep(s.x_opt, new.x_opt),
+            norm_opt=keep(s.norm_opt, new.norm_opt), norm0=s.norm0,
+            best_it=keep(s.best_it, new.best_it),
+            best_l2=keep(s.best_l2, new.best_l2),
+            impr_it=keep(s.impr_it, new.impr_it),
+            it_m=keep(s.it_m, new.it_m),
+            done=frozen | new.done,
         )
 
     final = jax.lax.while_loop(cond, body, init)
     # the loop may exit on the CURRENT residual crossing target while
     # x_opt still holds an older iterate — return whichever is better
-    final_norm = jnp.max(jnp.abs(final.r))
+    final_norm = linf(final.r)
     use_x = final_norm <= final.norm_opt
     converged = jnp.minimum(final_norm, final.norm_opt) <= target
+    # stall classification against the member's OWN frozen counter
+    # (it_m == it unbatched): under member_axis the global it keeps
+    # climbing after a member froze, which would misclassify an early
+    # give-up exit as a stall
+    stalled = ~converged & ((final.it_m - final.impr_it) >= stall_iters)
+    sq = (lambda v: v.reshape(v.shape[0])) if member_axis else (lambda v: v)
     return BiCGSTABResult(
         x=jnp.where(use_x, final.x, final.x_opt),
-        iters=final.it,
-        residual=jnp.where(use_x, final_norm, final.norm_opt),
-        converged=converged,
-        stalled=~converged & ((final.it - final.impr_it) >= stall_iters),
+        iters=sq(final.it_m) if member_axis else final.it,
+        residual=sq(jnp.where(use_x, final_norm, final.norm_opt)),
+        converged=sq(converged),
+        stalled=sq(stalled),
     )

@@ -109,7 +109,8 @@ def throughput(sim) -> dict:
     if hasattr(sim, "forest"):
         cells = len(sim.forest.blocks) * sim.forest.bs ** 2
     else:
-        cells = sim.grid.nx * sim.grid.ny
+        # a fleet steps B member grids per dispatch (fleet.FleetSim)
+        cells = sim.grid.nx * sim.grid.ny * getattr(sim, "members", 1)
     wall = getattr(sim, "timers", None)
     # top-level phases are non-nested by construction (adapt() refreshes
     # tables BEFORE opening its phase); "a/b"-named sub-phases break the
@@ -344,10 +345,14 @@ class HostCounters:
 # always present; fields that do not apply to a path (AMR shape on a
 # uniform run, comm volume on a single device, counters when disabled)
 # are null — consumers key on names, never on presence.
-METRICS_SCHEMA_VERSION = 2
+METRICS_SCHEMA_VERSION = 3
 METRICS_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
-    # solver health + timestep state (the step's existing diag pull)
+    # solver health + timestep state (the step's existing diag pull).
+    # On a FLEET record (schema v3) these scalar slots carry the
+    # fleet-conservative aggregate — umax/iters/residual/div_linf max,
+    # dt/dt_next min, energy sum, converged all, stalled any — and the
+    # per-member vectors live in member_health below.
     "umax", "dt_next",
     "poisson_iters", "poisson_residual",
     "poisson_converged", "poisson_stalled",
@@ -365,6 +370,11 @@ METRICS_KEYS = (
     # (absolute bytes) + replayed-step delta of the snapshot-cadence
     # recovery path — the D2H win made visible in post --metrics
     "snap_ring_bytes", "replayed_steps",
+    # fleet batching (schema v3, fleet.py): member count of the fused
+    # dispatch, its throughput in member-steps/s (B / wall of the one
+    # dispatch — THE dispatch-amortization metric), and per-member
+    # solver health folded into the one record as {key: [B values]}
+    "fleet_members", "member_steps_per_s", "member_health",
     # merged PhaseTimers wall times (per-step deltas, ms)
     "phase_ms",
 )
@@ -374,7 +384,7 @@ _DIAG_KEYS = ("umax", "dt_next", "poisson_iters", "poisson_residual",
               "div_linf")
 
 _INT_KEYS = {"poisson_iters"}
-_BOOL_KEYS = {"poisson_converged", "poisson_stalled"}
+_BOOL_KEYS = {"poisson_converged", "poisson_stalled", "finite"}
 
 
 def _jsonable(key: str, v):
@@ -385,6 +395,26 @@ def _jsonable(key: str, v):
     if key in _BOOL_KEYS:
         return bool(v)
     return float(v)
+
+
+# fleet records (schema v3): how each per-member [B] diag vector folds
+# into the record's scalar slot — conservative aggregates (the value an
+# alerting consumer should key on), with the full vectors preserved in
+# member_health
+_FLEET_AGG = {
+    "umax": np.max, "dt_next": np.min,
+    "poisson_iters": np.max, "poisson_residual": np.max,
+    "poisson_converged": np.all, "poisson_stalled": np.any,
+    "energy": np.sum, "div_linf": np.max,
+}
+
+# the per-member vectors folded into member_health (diag keys plus the
+# health/clock extras the guard's pull carries)
+_MEMBER_KEYS = _DIAG_KEYS + ("finite", "dt")
+
+
+def _member_list(key: str, v):
+    return [_jsonable(key, x) for x in np.asarray(v).ravel()]
 
 
 class MetricsRecorder:
@@ -432,9 +462,26 @@ class MetricsRecorder:
     def record_step(self, *, step: int, t: float, diag: dict,
                     wall_ms: Optional[float] = None, sim=None,
                     dt: Optional[float] = None) -> dict:
-        vals = {k: diag[k] for k in _DIAG_KEYS if k in diag}
+        vals = {k: diag[k] for k in _MEMBER_KEYS if k in diag}
         if any(isinstance(v, jax.Array) for v in vals.values()):
             vals = jax.device_get(vals)   # library-path fallback: 1 pull
+        # fleet record (schema v3): [B]-vector diags — fold the
+        # per-member detail into member_health, put the conservative
+        # aggregate in the scalar slots
+        vecs = [np.asarray(v) for v in vals.values() if np.ndim(v) >= 1]
+        fleet_b = int(vecs[0].shape[0]) if vecs else 0
+        member_health = None
+        if fleet_b:
+            member_health = {k: _member_list(k, v)
+                             for k, v in vals.items()
+                             if np.ndim(v) >= 1}
+            vals = {k: (_FLEET_AGG[k](np.asarray(v))
+                        if np.ndim(v) >= 1 and k in _FLEET_AGG else v)
+                    for k, v in vals.items()}
+        if dt is not None and np.ndim(dt) >= 1:
+            if member_health is not None:
+                member_health["dt"] = _member_list("dt", dt)
+            dt = float(np.min(dt))    # the pacing (slowest-dt) member
         if dt is None:
             dt = (t - self._last_time) if self._last_time is not None \
                 else None
@@ -452,6 +499,11 @@ class MetricsRecorder:
         rec.update(self._comm_fields(sim))
         rec.update(self._counter_fields())
         rec.update(self._guard_fields())
+        rec["fleet_members"] = fleet_b or None
+        rec["member_steps_per_s"] = (
+            round(fleet_b * 1e3 / wall_ms, 3)
+            if fleet_b and wall_ms else None)
+        rec["member_health"] = member_health
         rec["phase_ms"] = self._phase_fields()
         if self.sink is not None:
             self.sink.emit(event="metrics", **rec)
@@ -584,5 +636,10 @@ def summarize_metrics(records: list) -> dict:
                             if col("snap_ring_bytes") else None),
         "replayed_steps_total": (sum(col("replayed_steps"))
                                  if col("replayed_steps") else None),
+        # fleet batching (schema v3): member count + the
+        # dispatch-amortization throughput metric
+        "fleet_members": (col("fleet_members")[-1]
+                          if col("fleet_members") else None),
+        "member_steps_per_s": stats(col("member_steps_per_s")),
     }
     return out

@@ -52,15 +52,22 @@ in ``io.py`` — this module is the supervision half on top of it:
   semantics: the grace window is spent writing the restart point, not
   dying mid-collective).
 
+- :class:`FleetStepGuard`: the per-member generalization for the
+  fleet-batched driver (fleet.py) — vectorized verdicts over the [B]
+  diag vectors of one fused dispatch; a bad member restores ONLY its
+  slice of the device snapshot ring and replays solo, healthy members
+  never rewind.
+
 Multi-host note: the verdict scalars are outputs of global reductions
 (replicated by SPMD semantics) and the device snapshots are per-shard
 local copies (no collective at all — strictly safer than the host
 gather they replace), so every process reaches the same ladder
 decision in the same order — the determinism contract of
-``parallel/launch.py`` extends to recovery. One known pod-scale gap
-remains a ROADMAP open item: the SIGTERM latch is per-process (hosts
-preempted at different instants need a cross-process agreement before
-the collective checkpoint).
+``parallel/launch.py`` extends to recovery. The SIGTERM latch is
+per-process but the DECISION is not: :meth:`PreemptionGuard.agree`
+min-allreduces the flag at every step boundary, so all hosts enter the
+collective checkpoint at the same step (the former ROADMAP pod gap
+(a); drilled by the skewed-delivery phase of the multihost harness).
 
 Known non-recoverable failure classes are listed in ROADMAP.md "Open
 items" (e.g. losing a process mid-collective changes the topology under
@@ -800,6 +807,263 @@ class StepGuard:
             + (f" (post-mortem checkpoint: {pm})" if pm else ""))
 
 
+# ---------------------------------------------------------------------------
+# per-member supervision for the fleet-batched driver (fleet.py)
+# ---------------------------------------------------------------------------
+
+class FleetStepGuard(StepGuard):
+    """Vectorized verdicts + per-member recovery for ``FleetSim``.
+
+    The fused fleet dispatch is the hot path: ONE batched pull carries
+    [B] diag vectors, every member is classified independently (the
+    same ``health_verdict`` policy per member, plus an independent
+    :class:`PhysicsWatchdog` clone per member — pass one prototype via
+    ``watchdog=`` and it is deep-copied B times). Recovery is the cold
+    path and PER MEMBER:
+
+    - a bad member restores ONLY its slice of the latest device
+      snapshot (``FleetSim.set_member_state`` — every other member's
+      values pass through bit-unchanged), replays its recorded
+      per-member dts solo through ``member_step_once`` (faults
+      suspended, exact-solve branches reproduced), then retries the
+      failed step at dt/2 and, on a second failure, with the exact
+      Poisson solve;
+    - HEALTHY MEMBERS NEVER REWIND: their step-N states from the fused
+      dispatch commit as usual, bit-identical to an unfaulted run
+      (tests/test_fleet.py pins this with a per-member NaN drill);
+    - the per-member ladder has NO disk rung — a disk restore would
+      rewind every member (healthy trajectories included), so it goes
+      retry -> escalate -> abort, and whole-fleet disk restore remains
+      the operator-level restart path.
+
+    Solo replay note: the solo executable deviates from the fused
+    member slice by the documented ~1e-16..1e-13 MG FMA-contraction
+    noise (fleet.py module docstring), so a replayed member is
+    equal-to-solo, not bit-equal-to-fused; the default ``snap_every=1``
+    keeps fleet replays at zero steps unless a cadence is requested.
+
+    The fleet verdict is EAGER (``lag`` is forced off): under the
+    one-step-lagged verdict a dispatch stacked on an undetected-bad
+    step N is discarded wholesale — but a FLEET dispatch of step N+1
+    is garbage only in the bad member's slice and a perfectly good
+    step N+1 for the other B-1 members, so discarding it would either
+    rewind healthy members (recomputing their trajectories — exactly
+    what per-member recovery forbids) or fork a per-member step-count
+    catch-up. Verdicting eagerly costs NO extra pull: it is the same
+    ONE batched device_get per step the sync fleet driver already
+    pays for the whole fleet — the fleet's throughput lever is
+    dispatch amortization across members, which is orthogonal to the
+    lag (a latency lever for the single-case drivers).
+
+    Injected ``poisson_giveup`` faults flag member 0 (the same member
+    ``faults.poison_velocity``/``scale_velocity`` target on a fleet).
+    """
+
+    def __init__(self, sim, *, watchdog=None, **kw):
+        kw["lag"] = False     # eager by design — see the docstring
+        super().__init__(sim, watchdog=None, **kw)
+        import copy
+        self.member_watchdogs = (
+            [copy.deepcopy(watchdog) for _ in range(sim.members)]
+            if watchdog is not None else None)
+
+    # -- vectorized verdict -------------------------------------------
+    def _resolve_oldest(self) -> dict:
+        pend = self._pendings.pop(0)
+        vals = _host_scalars(pend.diag, _PULL_KEYS)   # [B] vectors
+        verdicts = self._member_verdicts(vals, pend.step0)
+        bad = [m for m, v in enumerate(verdicts) if not v.ok]
+        if not bad:
+            return self._commit(pend, vals)
+        return self._recover_members(pend, vals, verdicts, bad)
+
+    def _one_member_verdict(self, m: int, mv: dict,
+                            step: int) -> StepVerdict:
+        """THE per-member verdict policy — shared by the fused-dispatch
+        classification and the solo retry, so a policy change can never
+        drift between them: health -> per-member watchdog -> member-0
+        giveup injection."""
+        tol = float(getattr(self.sim.cfg, "poisson_tol", 0.0))
+        v = health_verdict(mv,
+                           residual_ok=(100.0 * tol if tol > 0 else None))
+        if v.ok and self.member_watchdogs is not None:
+            reason = self.member_watchdogs[m].check(mv)
+            if reason is not None:
+                v = StepVerdict(False, reason)
+        if v.ok and m == 0 and self.faults is not None \
+                and self.faults.poisson_giveup_at(step):
+            v = StepVerdict(False, "poisson_giveup(injected)")
+        return v
+
+    def _member_verdicts(self, vals: dict, step: int) -> list:
+        return [
+            self._one_member_verdict(
+                m, {k: v[m] for k, v in vals.items() if np.ndim(v) >= 1},
+                step)
+            for m in range(self.sim.members)]
+
+    def _commit(self, pend: _Pending, vals: dict) -> dict:
+        sim = self.sim
+        dts = np.asarray(vals["dt"], np.float64)
+        if not pend.advanced:
+            # async path: settle every member's clock from the pulled
+            # per-member dt vector (commits run in step order)
+            sim.times = sim.times + dts
+            sim.time = float(sim.times.min())
+        if self.member_watchdogs is not None:
+            for m in range(sim.members):
+                self.member_watchdogs[m].observe(
+                    {k: v[m] for k, v in vals.items()})
+        if pend.snap is not None:
+            # capture-time clocks were lagged — settle them now
+            pend.snap.meta["time"] = sim.time
+            pend.snap.meta["times"] = np.array(sim.times)
+            self.ring.append(pend.snap)
+            self._replay.clear()
+        else:
+            self._replay.append((dts, pend.exact, None))
+        if self.faults is not None:
+            self.faults.fire_post_step(pend.step0 + 1)
+        return {**pend.diag, **vals, "step": pend.step0 + 1,
+                "t": sim.time, "dt": dts}
+
+    # -- per-member recovery ------------------------------------------
+    def _recover_members(self, pend: _Pending, vals: dict,
+                         verdicts: list, bad: list) -> dict:
+        sim = self.sim
+        # discard (and refund) any dispatch stacked on the bad step
+        for p in self._pendings:
+            for ent in p.fired:
+                ent[1] += 1
+        self._pendings.clear()
+        # the optimistic post-step snapshot contains the bad slices —
+        # it must never become an anchor
+        pend.snap = None
+        vals = {k: np.array(v) for k, v in vals.items()}   # writable
+        dts = np.asarray(vals["dt"], np.float64)
+        if not pend.advanced:
+            # commit the HEALTHY members' step N (their fused results
+            # are good; they never rewind)
+            for m in range(sim.members):
+                if verdicts[m].ok:
+                    sim.times[m] += dts[m]
+        # the dt cache may hold a discarded garbage dispatch's dt_next
+        # (lagged mode dispatched N+1 on top of the bad N): re-anchor
+        # EVERY member on step N's pulled dt_next — the same floats the
+        # unfaulted run keeps on device, so healthy trajectories stay
+        # bit-identical
+        import jax.numpy as jnp
+        sim._next_dt = jnp.asarray(np.asarray(vals["dt_next"]),
+                                   sim.grid.dtype)
+        anchor = self.ring[-1]
+        for m in bad:
+            mv = self._recover_member(m, anchor, pend.step0, vals,
+                                      verdicts[m])
+            # the record reflects what actually committed for m
+            for k, val in mv.items():
+                if k in vals and np.ndim(vals[k]) >= 1:
+                    vals[k][m] = val
+        if self.member_watchdogs is not None:
+            for m in range(sim.members):
+                if verdicts[m].ok:
+                    self.member_watchdogs[m].observe(
+                        {k: v[m] for k, v in vals.items()})
+        sim.time = float(sim.times.min())
+        # every member healthy again: fresh anchor, clean replay base
+        self.ring.append(self._snapshot())
+        self._replay.clear()
+        self._since_snap = 0
+        if self.faults is not None:
+            self.faults.fire_post_step(pend.step0 + 1)
+        return {**pend.diag, **vals, "step": pend.step0 + 1,
+                "t": sim.time, "dt": np.asarray(vals["dt"])}
+
+    def _recover_member(self, m: int, anchor, step0: int, vals: dict,
+                        v: StepVerdict) -> dict:
+        sim = self.sim
+        dt_used = float(np.asarray(vals["dt"])[m])
+        rung = 0
+        while True:
+            if not self.recover or rung >= 2:
+                self._abort_member(m, step0, v, vals, dt_used)
+            replayed = self._rewind_member(m, anchor)
+            exact = rung == 1
+            retry_dt = (0.5 * dt_used
+                        if rung == 0 and np.isfinite(dt_used)
+                        and dt_used > 0 else None)
+            self._emit(step=step0, member=m, verdict=v.reason,
+                       action=("retry" if rung == 0 else "escalate"),
+                       dt=dt_used, rung=rung, replayed=replayed)
+            self.recoveries += 1
+            # the retry is a FRESH attempt of step0: armed *K faults
+            # re-fire (looked up by the step being retried — the
+            # SHARED fleet counter already advanced past it)
+            self._last_fired = (
+                self.faults.apply_pre_step(sim, step=step0)
+                if self.faults is not None else ())
+            diag = sim.member_step_once(
+                m, dt=retry_dt, exact=(exact or step0 < 10))
+            mv = _host_scalars(diag, _PULL_KEYS)
+            v2 = self._one_member_verdict(m, mv, step0)
+            if v2.ok:
+                sim.times[m] += float(mv["dt"])
+                sim.time = float(sim.times.min())
+                sim.set_member_next_dt(m, mv["dt_next"])
+                if self.member_watchdogs is not None:
+                    self.member_watchdogs[m].observe(mv)
+                return mv
+            v = v2
+            dt_used = float(mv["dt"])
+            rung += 1
+
+    def _rewind_member(self, m: int, anchor) -> int:
+        """Restore member ``m``'s slice from the anchor snapshot, then
+        replay its recorded per-member dts solo (faults suspended, no
+        verdict pulls) up to the failed step."""
+        import contextlib
+        sim = self.sim
+        sim.set_member_state(m, type(sim.state)(
+            *(anchor.payload[k][m] for k in sim.state._fields)))
+        sim.times[m] = float(np.asarray(anchor.meta["times"])[m])
+        n = 0
+        ctx = (self.faults.suspend() if self.faults is not None
+               else contextlib.nullcontext())
+        with ctx:
+            for rdts, rexact, _ in self._replay:
+                rdt = float(np.asarray(rdts)[m])
+                sim.member_step_once(m, dt=rdt, exact=rexact)
+                sim.times[m] += rdt
+                n += 1
+        self.replayed_steps += n
+        return n
+
+    def _abort_member(self, m: int, step: int, v: StepVerdict,
+                      vals: dict, dt_used: float) -> None:
+        sim = self.sim
+        pm = None
+        if self.postmortem_dir:
+            try:
+                from .io import save_checkpoint
+                save_checkpoint(self.postmortem_dir, sim)
+                pm = self.postmortem_dir
+            except Exception as e:   # the abort must not be masked
+                print(f"cup2d_tpu: post-mortem checkpoint failed: {e}",
+                      file=sys.stderr)
+        flog = getattr(sim, "force_log", None)
+        if flog is not None and not flog.closed:
+            flog.close()
+        summary = {k: _as_float(np.asarray(vals[k])[m])
+                   for k in ("umax", "poisson_residual", "poisson_iters")
+                   if k in vals}
+        self._emit(step=step, member=m, verdict=v.reason,
+                   action="abort", dt=dt_used, postmortem=pm,
+                   diag=summary)
+        raise ResilienceAbort(
+            f"step {step}, member {m}: {v.reason}; per-member ladder "
+            "exhausted"
+            + (f" (post-mortem checkpoint: {pm})" if pm else ""))
+
+
 def _on_device(diag: dict) -> bool:
     import jax
     return any(isinstance(v, jax.Array) for v in diag.values())
@@ -839,6 +1103,40 @@ class PreemptionGuard:
         for s in signums:
             self._prev[s] = signal.signal(s, _handler)
         return self
+
+    def agree(self) -> bool:
+        """Cross-process agreement on the latch (the former ROADMAP pod
+        gap (a)): hosts preempted at different instants must not enter
+        MISMATCHED collectives — one stepping while another starts the
+        collective checkpoint save hangs the SPMD program out its grace
+        window. The flag itself stays per-process (a signal handler
+        cannot run collectives); the DECISION is made here: at every
+        step boundary each process contributes its local flag to a tiny
+        min-allreduce (an allgather of one int32 — the cheap dedicated
+        collective; on pods it rides DCN in microseconds against a
+        multi-ms step), and the checkpoint fires only once EVERY
+        process has latched — so all hosts enter the collective save at
+        the SAME step boundary. A lone signal on one host keeps the run
+        alive by design: real preemption notifies every worker, and
+        stopping on ANY flag would turn a stray operator signal into a
+        fleet-wide shutdown. Call it at the same loop point on every
+        process — it is a collective on pods. Single-host (or before
+        distributed init): just the local flag, no device/collective
+        cost. Drilled with skewed sigterm@N delivery by the multihost
+        harness (tests/_multihost_worker.py)."""
+        import jax
+        probe = getattr(jax.distributed, "is_initialized", None)
+        if probe is not None:
+            inited = bool(probe())
+        else:
+            from jax._src import distributed as _dist
+            inited = _dist.global_state.client is not None
+        if not inited or jax.process_count() == 1:
+            return self.triggered
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            np.asarray([1 if self.triggered else 0], np.int32))
+        return bool(np.min(flags) > 0)
 
     def uninstall(self) -> None:
         import signal

@@ -179,6 +179,44 @@ def main():
     print(f"DIGEST restore {digest()}", flush=True)
     assert not glob.glob(os.path.join(outdir, "ck.tmp*")), \
         "checkpoint temp dir left behind"
+
+    # ---- SIGTERM latch agreement (the former ROADMAP pod gap (a)) ----
+    # Skewed preemption delivery: the faults.py sigterm injector fires
+    # on process 0 after step 3 and on process 1 after step 5 — exactly
+    # the hosts-preempted-at-different-instants hazard. The per-process
+    # latch alone would send process 0 into the collective checkpoint
+    # at boundary 3 while process 1 keeps stepping (a mismatched-
+    # collective hang); PreemptionGuard.agree() min-allreduces the flag
+    # at every boundary, so BOTH processes agree to stop at boundary 5
+    # (the first where every latch is set) and enter the collective
+    # save together.
+    from cup2d_tpu.faults import FaultPlan
+    from cup2d_tpu.resilience import PreemptionGuard
+
+    plan = FaultPlan(f"sigterm@{3 if pid == 0 else 5}")
+    stop = PreemptionGuard().install()
+    agreed_at = None
+    local_at = None
+    try:
+        for k in range(1, 9):
+            sim.step_once(dt=1e-3)
+            plan.fire_post_step(k)
+            if stop.triggered and local_at is None:
+                local_at = k
+            if stop.agree():          # collective: same call count on
+                agreed_at = k         # every process, every boundary
+                break
+    finally:
+        stop.uninstall()
+    assert agreed_at is not None, "agreement never reached"
+    # the locally-latched process saw its flag BEFORE the agreement
+    # (process 0 latches at 3, agreement lands at 5 on both)
+    assert local_at is not None and local_at <= agreed_at
+    ck2 = os.path.join(outdir, "ck_sigterm")
+    save_checkpoint(ck2, sim)         # the collective save, in lockstep
+    assert os.path.exists(os.path.join(ck2, "meta.json"))
+    print(f"SIGTERM_AGREE {agreed_at}", flush=True)
+
     print("DONE", flush=True)
 
 

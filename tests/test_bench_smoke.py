@@ -1,0 +1,95 @@
+"""Tier-1 bench smoke: a bench regression must never land silently.
+
+BENCH_r05.json ended in an rc=1 stack trace because `_init_platform`
+probed only `jax.devices()` — the axon backend registers devices
+eagerly and defers the real failure to the first op, so the probe
+passed and the bench died at its first jnp call. Nothing in CI ran
+bench.py at all, so the breakage shipped. This smoke runs the REAL
+bench.py entry point as a subprocess on CPU with a tiny configuration
+and pins the driver contract: rc 0, ONE JSON line, the platform
+recorded, the telemetry block in the metrics schema, and the fleet
+curve present.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_cpu_smoke():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)           # no virtual-device forcing
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=ROOT,
+        BENCH_SIZE="32",                 # level-2 grid: seconds, not minutes
+        BENCH_WARMUP="1",
+        BENCH_STEPS="2",
+        BENCH_ADAPTIVE="0",              # the AMR bench is its own path
+        BENCH_FLEET="1,2",
+        BENCH_FLEET_SIZE="16",
+        BENCH_FLEET_STEPS="5",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    # driver contract: ONE JSON object on stdout
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    out = json.loads(lines[0])
+    # the r05 failure class: the platform must be probed with a real op
+    # and RECORDED (an honest 'platform: cpu', never a crash)
+    assert out["platform"] == "cpu"
+    assert out["backend"] == "cpu"
+    assert out["metric"] and out["value"] > 0
+    # telemetry block rides the run-metrics schema (profiling.py)
+    from cup2d_tpu.profiling import METRICS_KEYS
+    summary = out["telemetry"]["summary"]
+    assert summary["steps"] == 2
+    last = out["telemetry"]["last_records"][-1]
+    assert set(last) == set(METRICS_KEYS)
+    # fleet curve (the -fleet bench mode): every requested B measured
+    fleet = out["fleet"]
+    assert "error" not in fleet, fleet
+    assert [p["members"] for p in fleet["points"]] == [1, 2]
+    assert all(p["member_steps_per_s"] > 0 for p in fleet["points"])
+    assert fleet["speedup_vs_b1"] > 0
+
+
+@pytest.mark.slow   # ~5 s subprocess; the satellite's tier-1 ask is
+#                     the smoke above — this drills the broken-box
+#                     fallback branch specifically
+def test_platform_fallback_on_deferred_backend_failure():
+    """The r05 failure class itself: a backend whose devices register
+    fine but whose FIRST OP raises (the axon behavior). The fallback
+    must clear the poisoned backend cache, flip to CPU and succeed —
+    run in a clean subprocess with the first probe stubbed to fail
+    (clear_backends in the live test process would invalidate every
+    array the suite holds)."""
+    script = (
+        "import bench\n"
+        "orig = bench._probe_platform\n"
+        "state = {'n': 0}\n"
+        "def flaky():\n"
+        "    if state['n'] == 0:\n"
+        "        state['n'] += 1\n"
+        "        raise RuntimeError('deferred backend failure (sim)')\n"
+        "    return orig()\n"
+        "bench._probe_platform = flaky\n"
+        "print(bench._init_platform())\n"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=ROOT)
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, env=env,
+                          cwd=ROOT, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout.strip().splitlines()[-1] == "cpu"
+    assert "falling back to cpu" in proc.stderr

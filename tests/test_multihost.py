@@ -55,6 +55,7 @@ def test_two_process_amr_determinism(tmp_path):
     digests = []
     iohashes = []
     buckets = []
+    sigterms = []
     for out in outs:
         lines = [ln for ln in out.splitlines() if ln.startswith("DIGEST")]
         assert len(lines) == 4, out       # 3 cycles + post-restore
@@ -63,6 +64,8 @@ def test_two_process_amr_determinism(tmp_path):
             [ln for ln in out.splitlines() if ln.startswith("IOHASH")])
         buckets.append([ln for ln in out.splitlines()
                         if ln.startswith("BUCKET")])
+        sigterms.append([ln for ln in out.splitlines()
+                         if ln.startswith("SIGTERM_AGREE")])
         assert buckets[-1], out
         assert "DONE" in out
     # the hard case's bucket line must also agree across processes
@@ -75,3 +78,8 @@ def test_two_process_amr_determinism(tmp_path):
     # barrier), and the restored run continued identically (the 4th
     # digest above)
     assert iohashes[0] and iohashes[0] == iohashes[1], iohashes
+    # SIGTERM latch agreement (ROADMAP pod gap (a)): skewed sigterm@N
+    # delivery (step 3 on pid 0, step 5 on pid 1) must make BOTH
+    # processes stop at the SAME step boundary — the later latch —
+    # and enter the collective checkpoint together
+    assert sigterms[0] == sigterms[1] == ["SIGTERM_AGREE 5"], sigterms

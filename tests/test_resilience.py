@@ -117,6 +117,11 @@ def test_fault_plan_parse():
 # zero-overhead contract: bit-identical, no extra pulls, no retraces
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow   # ~15 s; STRICT SUPERSET stays tier-1:
+#                     test_telemetry.test_metrics_on_bit_identical_
+#                     equal_pulls runs the same shaped driver with
+#                     guard + recorder + counters + watchdog and
+#                     asserts the same bit-identity/pull/trace set
 def test_guard_unfaulted_bit_identical_uniform(tmp_path, monkeypatch):
     traces = {"n": 0}
     orig_impl = Simulation._flow_step_impl

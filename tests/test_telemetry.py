@@ -55,6 +55,32 @@ def _amr_sim():
 # schema stability (golden key set): every producer emits the SAME keys
 # ---------------------------------------------------------------------------
 
+# the LITERAL schema-v3 key set: METRICS_KEYS is the producers' truth,
+# this tuple is the consumers' — any drift between them (a key renamed,
+# dropped, or added without bumping the schema) fails here on purpose.
+# v3 added the fleet-batching fields (fleet_members / member_steps_per_s
+# / member_health, fleet.py).
+_SCHEMA_V3_KEYS = (
+    "schema", "step", "t", "dt", "wall_ms",
+    "umax", "dt_next",
+    "poisson_iters", "poisson_residual",
+    "poisson_converged", "poisson_stalled",
+    "energy", "div_linf",
+    "n_blocks", "blocks_per_level", "refines", "coarsens",
+    "halo_real_bytes", "halo_padded_bytes",
+    "jit_compiles", "device_gets", "state_gathers", "hbm_peak_bytes",
+    "snap_ring_bytes", "replayed_steps",
+    "fleet_members", "member_steps_per_s", "member_health",
+    "phase_ms",
+)
+
+
+def test_metrics_schema_v3_key_set_pinned():
+    from cup2d_tpu.profiling import METRICS_SCHEMA_VERSION
+    assert METRICS_SCHEMA_VERSION == 3
+    assert METRICS_KEYS == _SCHEMA_V3_KEYS
+
+
 def test_metrics_schema_stable_uniform_amr_bench():
     gold = set(METRICS_KEYS)
 
