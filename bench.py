@@ -449,6 +449,99 @@ def run_fleet(size: int, members_list, n_steps: int = 40,
     }
 
 
+def run_poisson_curve(size: int, tol_rel: float = 1e-3,
+                      n_rep: int = 3):
+    """Poisson solver micro-curve (PR 6): iterations-to-tolerance and
+    ms/solve PER SOLVE PATH on one cold RHS at a FIXED relative
+    residual target, so the solver trajectory is tracked across rounds
+    in the BENCH JSON instead of living only in ad-hoc probes.
+
+    Paths: the reference's block-Jacobi-preconditioned Krylov
+    (bicgstab_jacobi — the AMR smoother's scaling baseline), the
+    production uniform default (bicgstab_mg), and the FAS multigrid
+    full solver in V-cycle and FMG-opening form (fas_v / fas_f,
+    poisson.mg_solve — the CUP2D_POIS=fas path). Iteration counts are
+    platform-independent; ms figures carry the usual host-fence
+    methodology (latency floor subtracted).
+
+    The 1e-3 target is the deepest one every path can HONESTLY reach
+    in f32: mg_solve converges on the true residual b - A(x), whose
+    f32 evaluation floor on this case is ~2e-4 relative (eps * |x|
+    amplified through the undivided Laplacian — measured, f64 cycles
+    sail through to any target), while BiCGSTAB's recursive residual
+    drifts optimistically below that floor. Comparing at 1e-4 would
+    pit an honest residual against a drifted one."""
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.ops.stencil import divergence_rhs
+    from cup2d_tpu.poisson import (MultigridPreconditioner, bicgstab,
+                                   mg_solve)
+    from cup2d_tpu.uniform import UniformGrid, pad_vector
+
+    level = int(np.log2(size // 8))
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=1, level_start=0,
+                    extent=1.0, nu=4e-5, cfl=0.5, dtype="float32")
+    grid = UniformGrid(cfg, level=level)
+    state0 = bench_state(grid)
+    dt = jnp.asarray(0.5 * grid.h, grid.dtype)
+    b = divergence_rhs(pad_vector(state0.vel, 1),
+                       pad_vector(state0.udef, 1),
+                       state0.chi, 1, grid.h, dt)
+
+    # solver-precision cycles for the FAS arms (the CUP2D_POIS=fas
+    # hierarchy, see UniformGrid: a bf16 cycle is fine as a
+    # preconditioner but floors a FULL solver above the 1e-4 target).
+    # Both arms' hierarchies are built EXPLICITLY rather than reusing
+    # grid.mg: that one's cycle dtype follows the CUP2D_POIS latch, so
+    # a bench run under CUP2D_POIS=fas would silently time an
+    # f32-cycle preconditioner in the "production default" arm and
+    # break cross-round curve comparison.
+    mgp = MultigridPreconditioner(grid.ny, grid.nx, grid.dtype)
+    mgf = MultigridPreconditioner(grid.ny, grid.nx, grid.dtype,
+                                  cycle_dtype=grid.dtype)
+    solvers = {
+        "bicgstab_jacobi": lambda bb: bicgstab(
+            grid.laplacian, bb, M=grid.precond, tol=0.0,
+            tol_rel=tol_rel, max_iter=2000),
+        "bicgstab_mg": lambda bb: bicgstab(
+            grid.laplacian, bb, M=mgp, tol=0.0,
+            tol_rel=tol_rel, max_iter=200),
+        "fas_v": lambda bb: mg_solve(
+            grid.laplacian, bb, mgf, tol=0.0,
+            tol_rel=tol_rel, max_cycles=200),
+        "fas_f": lambda bb: mg_solve(
+            grid.laplacian, bb, mgf, tol=0.0,
+            tol_rel=tol_rel, max_cycles=200, fmg=True),
+    }
+    lat = None
+    paths = {}
+    norm0 = float(jnp.max(jnp.abs(b)))
+    for name, solve in solvers.items():
+        js = jax.jit(solve)
+        res = js(b)
+        _fence(res.x)
+        if lat is None:
+            lat = _latency_floor(dt)
+        t0 = time.perf_counter()
+        for _ in range(n_rep):
+            res = js(b)
+            _fence(res.x)
+        wall = max((time.perf_counter() - t0 - n_rep * lat) / n_rep,
+                   1e-9)
+        iters = int(res.iters)
+        paths[name] = {
+            "iters": iters,
+            "ms_per_solve": round(wall * 1e3, 3),
+            "ms_per_iter": round(wall / max(iters, 1) * 1e3, 3),
+            "residual_rel": float(res.residual) / norm0,
+            "converged": bool(res.converged),
+        }
+    return {"grid": f"{size}x{size}", "tol_rel": tol_rel,
+            "paths": paths,
+            "note": ("cold-RHS solves at a fixed relative target; "
+                     "iters are platform-independent, ms carries the "
+                     "fence methodology of run_size")}
+
+
 def _init_platform() -> str:
     """Initialize an available backend. On boxes without the configured
     accelerator, jax's first device probe dies with RuntimeError
@@ -527,6 +620,16 @@ def main():
                 n_steps=int(os.environ.get("BENCH_FLEET_STEPS", "40")))
         except Exception as e:           # noqa: BLE001 - bench must print
             fleet = {"error": f"{type(e).__name__}: {e}"}
+    # Poisson solve-path micro-curve (BENCH_POISSON=0 skips;
+    # BENCH_POISSON_SIZE picks the grid — 1024^2 default keeps the
+    # block-Jacobi baseline arm's iteration train bounded)
+    poisson = None
+    if os.environ.get("BENCH_POISSON", "1") != "0":
+        try:
+            poisson = run_poisson_curve(
+                int(os.environ.get("BENCH_POISSON_SIZE", "1024")))
+        except Exception as e:           # noqa: BLE001 - bench must print
+            poisson = {"error": f"{type(e).__name__}: {e}"}
 
     # PRIMARY metric: DEVICE-derived throughput (profiler module time
     # over chained steps). The fenced-wall number carries host/tunnel
@@ -592,6 +695,8 @@ def main():
         out["adaptive_canonical"] = adaptive
     if fleet:
         out["fleet"] = fleet
+    if poisson:
+        out["poisson_curve"] = poisson
     if secondary:
         out["secondary"] = secondary
     print(json.dumps(out))

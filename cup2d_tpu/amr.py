@@ -154,15 +154,22 @@ class AMRSim(ShapeHostMixin):
         self._pois_mode = os.environ.get("CUP2D_POIS", "structured")
         self._twolevel_form = os.environ.get("CUP2D_TWOLEVEL")
         # a typo'd A/B gate must not silently fall back and measure
-        # the same form on both arms
-        if self._pois_mode not in ("structured", "tables"):
+        # the same form on both arms. "fft" (PR 6): the forest-FFT
+        # preconditioned production solve — structured operator +
+        # ALWAYS-ON two-level coarse correction in the two-grid "mg2"
+        # form (pre-smooth, spectral base-level correction, post-
+        # smooth; see _pressure_project) instead of waiting for the
+        # iters>15 trigger with the weaker additive form. The
+        # uniform-only "fas"/"fas-f" tokens are rejected here: no FAS
+        # hierarchy exists on the composite forest.
+        if self._pois_mode not in ("structured", "tables", "fft"):
             raise ValueError(
                 f"CUP2D_POIS={self._pois_mode!r}: "
-                "expected structured|tables")
-        if self._twolevel_form not in (None, "additive", "mult"):
+                "expected structured|tables|fft")
+        if self._twolevel_form not in (None, "additive", "mult", "mg2"):
             raise ValueError(
                 f"CUP2D_TWOLEVEL={self._twolevel_form!r}: "
-                "expected additive|mult")
+                "expected additive|mult|mg2")
         if shapes is None:
             from .sim import make_shapes
             shapes = make_shapes(cfg)
@@ -240,11 +247,13 @@ class AMRSim(ShapeHostMixin):
         # end-state umax, keeps the diag (incl. the dt used) on device
         # and leaves clock settlement + the iters-trigger drain to the
         # guard's lagged pull — zero blocking host syncs per steady
-        # step. Side effect documented there: the two-level iters>15
-        # trigger sees the count one step later than the eager path
-        # (it is sticky hysteresis; one extra block-Jacobi-only solve
-        # before engagement). The shaped branch ignores the flag (its
-        # uvw/CoM pull feeds the host kinematics).
+        # step. The former side effect (the two-level iters>15 trigger
+        # seeing the count one step LATE) is closed by the guard's
+        # trigger-freshness window (resilience.StepGuard.step, PR 6):
+        # while the trigger is re-armed-but-off the in-flight verdict
+        # resolves BEFORE the next dispatch, so engagement lands at
+        # the same step as the eager path. The shaped branch ignores
+        # the flag (its uvw/CoM pull feeds the host kinematics).
         self.async_diag = False
         self._raster_jit = jax.jit(self._rasterize_impl)
         self._vorticity_jit = jax.jit(self._vorticity_impl)
@@ -787,8 +796,20 @@ class AMRSim(ShapeHostMixin):
             # hot-loop price. CUP2D_TWOLEVEL={additive,mult} (latched
             # in __init__, validated there) forces one form for A/B
             # probes.
+            # "mg2" (PR 6, the CUP2D_POIS=fft production form): a full
+            # two-grid cycle — block-Jacobi PRE-smooth, spectral
+            # base-level correction of the smoothed residual,
+            # block-Jacobi POST-smooth — i.e. the multiplicative
+            # composition symmetrized. Costs 2 A-applies + 3 GEMM
+            # smooths per application where additive pays 0 + 1, but
+            # contracts both the local high-frequency error AND the
+            # coarse modes each application, which is what cuts the
+            # Krylov train itself (additive 10/9/8 -> mg2 4/4/4
+            # iters/step at the 1e4-block probe, BASELINE.md round 6)
+            # instead of shaving per-iter cost.
             form = self._twolevel_form or (
-                "mult" if exact_poisson else "additive")
+                "mult" if exact_poisson else
+                ("mg2" if self._pois_mode == "fft" else "additive"))
             if form == "additive":
                 def M(r):
                     rc = _deposit(r * cih2)
@@ -796,6 +817,16 @@ class AMRSim(ShapeHostMixin):
                         rc, dctops, self._coarse_h2)
                     return _interp(ec, r) + apply_block_precond_blocks(
                         r, self.p_inv)
+            elif form == "mg2":
+                def M(r):
+                    e = apply_block_precond_blocks(r, self.p_inv)
+                    r1 = r - A(e)
+                    rc = _deposit(r1 * cih2)
+                    ec = coarse_neumann_solve_dct(
+                        rc, dctops, self._coarse_h2)
+                    e = e + _interp(ec, r)
+                    return e + apply_block_precond_blocks(
+                        r - A(e), self.p_inv)
             else:
                 def M(r):
                     rc = _deposit(r * cih2)
@@ -944,6 +975,28 @@ class AMRSim(ShapeHostMixin):
 
         return _deposit, _interp
 
+    @staticmethod
+    def _precond_cycles(res, tcoarse, exact_poisson):
+        """Coarse-correction cycle count of one solve (telemetry schema
+        v4): flexible BiCGSTAB applies M twice per iteration, plus the
+        one x0 = M(b) application of exact-mode cold starts; solves
+        without the two-level operand report 0. ``tcoarse is None`` is
+        a trace-time (pytree-structure) branch, so this costs nothing
+        on device."""
+        if tcoarse is None:
+            return jnp.zeros_like(res.iters)
+        return 2 * res.iters + (1 if exact_poisson else 0)
+
+    @property
+    def poisson_mode(self) -> str:
+        """Active production solve-path latch (telemetry schema v4):
+        the CUP2D_POIS mode plus the two-level trigger state, so an A/B
+        run's metrics.jsonl alone says which path each step took."""
+        if self._pois_mode == "fft":
+            return "bicgstab+fft"
+        return ("bicgstab+twolevel" if self._coarse_on
+                else "bicgstab+jacobi")
+
     def _energy(self, v, hsq):
         """Kinetic energy of the masked ordered velocity — the
         telemetry watchdog's first invariant, one fused reduction
@@ -983,6 +1036,8 @@ class AMRSim(ShapeHostMixin):
             "umax": jnp.max(jnp.abs(v)),
             "energy": self._energy(v, hsq),
             "div_linf": div_linf,
+            "precond_cycles": self._precond_cycles(
+                res, tcoarse, exact_poisson),
         }
         return v, p_new, diag
 
@@ -1055,6 +1110,8 @@ class AMRSim(ShapeHostMixin):
             "umax": jnp.max(jnp.abs(v)),
             "energy": self._energy(v, hsq),
             "div_linf": div_linf,
+            "precond_cycles": self._precond_cycles(
+                res, tcoarse, exact_poisson),
         }
         return v, p_new, uvw, diag
 
@@ -1540,8 +1597,14 @@ class AMRSim(ShapeHostMixin):
         change (block-Jacobi alone follows the uniform path's
         block-count scaling law on near-uniform forests — ~200
         iterations/step at 1e4 blocks, BASELINE.md r4 scale trace).
-        Maps build lazily on first engagement."""
+        Maps build lazily on first engagement. CUP2D_POIS=fft keeps
+        the correction ALWAYS on for production solves — cutting
+        iterations is the point of that mode, so it never waits for
+        the trigger's evidence (``_coarse_on`` is still set, so the
+        guard's replay trigger-state record stays truthful)."""
         if not exact:
+            if self._pois_mode == "fft":
+                self._coarse_on = True
             if not self._coarse_on and self._last_iters > 15:
                 self._coarse_on = True
             if not self._coarse_on:

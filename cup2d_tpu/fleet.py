@@ -79,7 +79,7 @@ from .ops.stencil import (
     laplacian5_neumann,
     pressure_gradient_update_fused,
 )
-from .poisson import bicgstab
+from .poisson import bicgstab, mg_solve
 from .uniform import FlowState, UniformGrid, pad_vector, taylor_green_state
 
 
@@ -200,12 +200,32 @@ class FleetSim:
         return dt_from_umax(umax, jnp.asarray(g.h, g.dtype),
                             g.cfg.nu, g.cfg.cfl)
 
+    @property
+    def poisson_mode(self) -> str:
+        """Active solve-path latch (telemetry schema v4). Fleet reads
+        the grid's latch — this module stays env-read-free by design
+        (tests/test_env_latch.py walks it)."""
+        return self.grid.poisson_mode
+
     def _pressure_solve(self, rhs: jnp.ndarray, exact: bool):
         """Member-batched ``UniformGrid.pressure_solve``: same
-        tolerances/refresh/stall policy, ONE fused Krylov loop with the
-        per-member convergence mask (poisson.bicgstab member_axis)."""
+        tolerances/refresh/stall policy and the same CUP2D_POIS solve
+        path as the solo driver, ONE fused loop with the per-member
+        convergence mask. Under ``fas`` the fleet runs member-batched
+        MG cycles (the V-cycle is leading-dim agnostic) with the SAME
+        converged-member freeze semantics — extra cycles the loop runs
+        for the slowest member are bit-exact identity for converged
+        ones (poisson.mg_solve member_axis); exact solves keep Krylov
+        exactly like the solo path."""
         g = self.grid
         cfg = self.cfg
+        if g.solver_mode == "fas" and not exact:
+            return mg_solve(
+                g.laplacian, rhs, g.mg,
+                tol=cfg.poisson_tol, tol_rel=cfg.poisson_tol_rel,
+                max_cycles=cfg.max_poisson_iterations,
+                fmg=g.fas_fmg, member_axis=True,
+            )
         return bicgstab(
             g.laplacian,
             rhs,
@@ -267,6 +287,9 @@ class FleetSim:
             "umax": umax,
             "energy": energy,
             "div_linf": div_linf,
+            # per-member preconditioner-cycle counts [B] (schema v4;
+            # the ONE shared accounting convention)
+            "precond_cycles": g.precond_cycles(res, exact_poisson),
             "dt_next": dt_from_umax(umax, jnp.asarray(h, g.dtype),
                                     g.cfg.nu, g.cfg.cfl),
         }

@@ -90,6 +90,14 @@ class ShardedUniformSim(UniformSim):
                 f"Nx={self.grid.nx} not divisible by mesh size "
                 f"{mesh.devices.size}"
             )
+        # FAS solve path (CUP2D_POIS=fas, latched in UniformGrid):
+        # rebuild the MG hierarchy mesh-aware so its finest-level
+        # smoothing sweeps run the comm/compute-overlapped shard_map
+        # form (shard_halo.overlap_jacobi_sweeps) instead of leaving
+        # the halo schedule to GSPMD. Must happen BEFORE the step
+        # re-jit below so the compiled step captures the overlapped
+        # smoother. No-op on the default Krylov path.
+        self.grid.attach_mesh(mesh)
         state_shardings = FlowState(
             vel=NamedSharding(mesh, vector_spec()),
             pres=NamedSharding(mesh, scalar_spec()),

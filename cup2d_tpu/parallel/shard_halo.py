@@ -506,6 +506,76 @@ def exchange_padding_stats(t: HaloTables, n_pad: int, D: int,
 
 
 # ---------------------------------------------------------------------------
+# comm/compute-overlapped Jacobi smoothing on x-split uniform fields
+# ---------------------------------------------------------------------------
+
+def overlap_jacobi_sweeps(e: jnp.ndarray, r: jnp.ndarray,
+                          inv_d: jnp.ndarray, omega: float, n: int,
+                          mesh: Mesh) -> jnp.ndarray:
+    """``n`` damped-Jacobi sweeps of the undivided zero-Neumann 5-point
+    Laplacian, ``e += omega (r - lap e) inv_d``, on [Ny, Nx] fields
+    x-split over ``mesh`` — the smoothing kernel of the FAS multigrid
+    solver's sharded path (poisson.MultigridPreconditioner(mesh=...)).
+
+    GSPMD lowers the stencil's shifted slices correctly but owns the
+    schedule; this form makes the arXiv:1309.7128 overlap structural:
+    each sweep ISSUES the two edge-column ``lax.ppermute``s first (the
+    sparse interior pairs — boundary devices receive zeros, exactly the
+    zero-ghost the wall stencil wants), then computes the y-direction
+    terms and the interior x-columns from purely local data inside the
+    exchange's latency-hiding window; only the two ghost-adjacent
+    columns consume the received buffers. Same dependence idiom as
+    ``_assemble_sharded``/``_poisson_apply_sharded``.
+
+    Arithmetic matches ``ops.stencil.laplacian5_neumann`` termwise
+    (xp + xm + yp + ym + p*(edges - 4), ghosts zero, rank-1 edge
+    correction), so the sharded sweep agrees with the single-device
+    sweep to reordering roundoff (tests/test_poisson.py pins the
+    equivalence)."""
+    D = mesh.devices.size
+
+    @partial(_shard_map, mesh=mesh,
+             in_specs=(P(None, "x"),) * 3, out_specs=P(None, "x"))
+    def run(e_loc, r_loc, inv_loc):
+        ny, w = e_loc.shape
+        idx = jax.lax.axis_index("x")
+        dt_ = e_loc.dtype
+        iy = jnp.arange(ny)
+        ix = jnp.arange(w)
+        one = jnp.ones((), dt_)
+        zero = jnp.zeros((), dt_)
+        ey = jnp.where((iy == 0) | (iy == ny - 1), one, zero)
+        # x walls exist only on the boundary devices of the split axis
+        ex = (jnp.where(ix == 0, one, zero) * (idx == 0).astype(dt_)
+              + jnp.where(ix == w - 1, one, zero)
+              * (idx == D - 1).astype(dt_))
+        corr = (ey[:, None] + ex[None, :]) - 4.0
+        zrow = jnp.zeros((1, w), dt_)
+
+        def sweep(_, ee):
+            # 1. exchange in flight: my left ghost is my left
+            #    neighbor's last column, my right ghost the right
+            #    neighbor's first; devices with no sender get zeros
+            gl = jax.lax.ppermute(
+                ee[:, -1:], "x", perm=[(d, d + 1) for d in range(D - 1)])
+            gr = jax.lax.ppermute(
+                ee[:, :1], "x", perm=[(d + 1, d) for d in range(D - 1)])
+            # 2. local terms (the latency-hiding window): y shifts and
+            #    the x contributions of interior columns read ee only
+            yp = jnp.concatenate([ee[1:, :], zrow], axis=0)
+            ym = jnp.concatenate([zrow, ee[:-1, :]], axis=0)
+            # 3. ghost-adjacent columns consume the received buffers
+            xp = jnp.concatenate([ee[:, 1:], gr], axis=1)
+            xm = jnp.concatenate([gl, ee[:, :-1]], axis=1)
+            lap = xp + xm + yp + ym + ee * corr
+            return ee + omega * (r_loc - lap) * inv_loc
+
+        return jax.lax.fori_loop(0, n, sweep, e_loc)
+
+    return run(e, r, inv_d)
+
+
+# ---------------------------------------------------------------------------
 # structured per-face Poisson operator across shards (round 5 on the mesh)
 # ---------------------------------------------------------------------------
 

@@ -345,7 +345,7 @@ class HostCounters:
 # always present; fields that do not apply to a path (AMR shape on a
 # uniform run, comm volume on a single device, counters when disabled)
 # are null — consumers key on names, never on presence.
-METRICS_SCHEMA_VERSION = 3
+METRICS_SCHEMA_VERSION = 4
 METRICS_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     # solver health + timestep state (the step's existing diag pull).
@@ -356,6 +356,12 @@ METRICS_KEYS = (
     "umax", "dt_next",
     "poisson_iters", "poisson_residual",
     "poisson_converged", "poisson_stalled",
+    # solve-path attribution (schema v4, PR 6): the ACTIVE Poisson
+    # path latch as a string (drivers' .poisson_mode — CUP2D_POIS mode
+    # + trigger state) and the per-step preconditioner/MG cycle count
+    # (rides the one diag pull), so an A/B run is attributable from
+    # metrics.jsonl alone
+    "poisson_mode", "precond_cycles",
     # fused on-device physics invariants (watchdog inputs)
     "energy", "div_linf",
     # AMR shape
@@ -381,9 +387,9 @@ METRICS_KEYS = (
 
 _DIAG_KEYS = ("umax", "dt_next", "poisson_iters", "poisson_residual",
               "poisson_converged", "poisson_stalled", "energy",
-              "div_linf")
+              "div_linf", "precond_cycles")
 
-_INT_KEYS = {"poisson_iters"}
+_INT_KEYS = {"poisson_iters", "precond_cycles"}
 _BOOL_KEYS = {"poisson_converged", "poisson_stalled", "finite"}
 
 
@@ -406,6 +412,7 @@ _FLEET_AGG = {
     "poisson_iters": np.max, "poisson_residual": np.max,
     "poisson_converged": np.all, "poisson_stalled": np.any,
     "energy": np.sum, "div_linf": np.max,
+    "precond_cycles": np.max,
 }
 
 # the per-member vectors folded into member_health (diag keys plus the
@@ -495,6 +502,13 @@ class MetricsRecorder:
         }
         for k in _DIAG_KEYS:
             rec[k] = _jsonable(k, vals.get(k))
+        # the active solve-path latch (schema v4): a host string — from
+        # the diag when a producer supplies one (bench), else the
+        # driver's .poisson_mode property; never a device value
+        pm = diag.get("poisson_mode")
+        if pm is None and sim is not None:
+            pm = getattr(sim, "poisson_mode", None)
+        rec["poisson_mode"] = str(pm) if pm is not None else None
         rec.update(self._amr_fields(sim))
         rec.update(self._comm_fields(sim))
         rec.update(self._counter_fields())
@@ -613,6 +627,11 @@ def summarize_metrics(records: list) -> dict:
         "poisson_iters": stats(col("poisson_iters")),
         "poisson_residual_max": (max(col("poisson_residual"))
                                  if col("poisson_residual") else None),
+        # solve-path attribution (schema v4): the distinct paths the
+        # run's steps took (the trigger can flip mid-run) + cycle cost
+        "poisson_modes": (sorted({str(m) for m in col("poisson_mode")})
+                          or None),
+        "precond_cycles": stats(col("precond_cycles")),
         "energy_first": energy[0] if energy else None,
         "energy_last": energy[-1] if energy else None,
         "div_linf_max": (max(col("div_linf"))

@@ -180,7 +180,7 @@ _INVARIANT_KEYS = ("energy", "div_linf")
 # (the async drivers put it in the diag — the lagged clock and the
 # replay dts come from this same pull)
 _PULL_KEYS = _HEALTH_KEYS + _INVARIANT_KEYS + (
-    "poisson_iters", "dt_next", "dt")
+    "poisson_iters", "precond_cycles", "dt_next", "dt")
 
 
 def _host_scalars(diag: dict, keys) -> dict:
@@ -371,10 +371,10 @@ class _Pending:
     """One dispatched-but-unverdicted step (the lagged slot)."""
 
     __slots__ = ("step0", "t0", "diag", "exact", "dt_host", "advanced",
-                 "snap", "trig", "fired")
+                 "snap", "trig", "fired", "mode")
 
     def __init__(self, step0, t0, diag, exact, dt_host, advanced,
-                 snap=None, trig=None, fired=()):
+                 snap=None, trig=None, fired=(), mode=None):
         self.step0 = step0
         self.t0 = t0
         self.diag = diag
@@ -384,6 +384,11 @@ class _Pending:
         self.snap = snap             # optimistic post-step device snapshot
         self.trig = trig             # (coarse_on, last_iters) at dispatch
         self.fired = fired           # fault entries this dispatch consumed
+        self.mode = mode             # sim.poisson_mode at dispatch (v4):
+        #                              a lagged commit must label step N
+        #                              with the path N actually TOOK, not
+        #                              the live mode after N+1's dispatch
+        #                              may have flipped the trigger
 
 
 class StepGuard:
@@ -444,6 +449,12 @@ class StepGuard:
         self._replay: list = []   # (dt, exact, trig) good steps since anchor
         self._since_snap = 0
         self._last_fired = ()     # fault entries the last _attempt consumed
+        # two-level-trigger freshness (PR 6): True from each re-anchor
+        # until the first PRODUCTION verdict delivers the new
+        # topology's iteration count — the window where the lagged
+        # pipeline would otherwise consult stale trigger evidence (see
+        # step())
+        self._trigger_fresh = False
         if self.lag and hasattr(sim, "async_diag"):
             # device-diag mode: the obstacle-free branches keep their
             # diag (incl. the dt used) on device and leave the clock
@@ -479,8 +490,38 @@ class StepGuard:
         record (host scalars + ``step``/``t``/``dt``), or None when the
         first lagged dispatch is still in flight."""
         self._seed()
-        self._dispatch(dt)
         out = None
+        # Two-level-trigger freshness window (PR 6): while the trigger
+        # is re-armed-but-off after a re-anchor (a regrid, or the run
+        # start), resolve the in-flight verdict BEFORE dispatching so
+        # the pulled step-N iteration count anchors the trigger that
+        # THIS dispatch consults — the preconditioner upgrade then
+        # lands at step N+1, same as the eager drivers, instead of the
+        # documented one-step-late N+2. The cost is one exposed pull
+        # round trip per re-anchor window (the window closes at the
+        # first production verdict, _commit); outside it the pull
+        # stays overlapped behind the next dispatch as before.
+        # Guards on the drain: the upcoming dispatch must be a
+        # PRODUCTION solve (exact dispatches neither consult the
+        # trigger nor, at run start, exist past step 9 — draining the
+        # steps-0..9 exact-startup pipeline would serialize ~10
+        # pointless exposed pulls for zero trigger evidence), at
+        # least one pending verdict must be production (exact verdicts
+        # cannot deliver the count that closes the window, _commit),
+        # and the sim must actually CONSULT the trigger — under
+        # CUP2D_POIS=fft the correction is forced on unconditionally
+        # (amr._use_coarse), so the pulled count decides nothing and
+        # the drain would just re-tax every post-regrid step.
+        if self.lag and self._trigger_fresh \
+                and hasattr(self.sim, "_coarse_on") \
+                and not self.sim._coarse_on \
+                and getattr(self.sim, "_pois_mode", None) != "fft" \
+                and not (self.sim.step_count < 10
+                         or getattr(self.sim, "_force_exact", False)) \
+                and any(not p.exact for p in self._pendings):
+            while self._pendings:
+                out = self._resolve_oldest()
+        self._dispatch(dt)
         while self._pendings:
             if self.lag and len(self._pendings) == 1 \
                     and _on_device(self._pendings[-1].diag):
@@ -525,6 +566,7 @@ class StepGuard:
         self.ring.append(self._snapshot())
         self._replay.clear()
         self._since_snap = 0
+        self._trigger_fresh = True
 
     def _trigger_state(self):
         """The two-level-trigger inputs the next dispatch consults —
@@ -547,7 +589,8 @@ class StepGuard:
             exact=bool(step0 < 10 or getattr(sim, "_force_exact", False)),
             dt_host=(sim.time - t0 if sim.time != t0 else None),
             advanced=(sim.time != t0), trig=trig,
-            fired=self._last_fired)
+            fired=self._last_fired,
+            mode=getattr(sim, "poisson_mode", None))
         # optimistic cadence snapshot: the post-step state must be
         # copied BEFORE the next dispatch donates its buffers; if this
         # step's lagged verdict comes back bad, the copy is discarded
@@ -588,12 +631,16 @@ class StepGuard:
             sim.time = sim.time + dt_used
             if hasattr(sim, "_last_iters") and not pend.exact \
                     and vals.get("poisson_iters") is not None:
-                # the pulled count IS the drained trigger scalar (the
-                # two-level trigger consults it at the NEXT dispatch —
-                # one step later than the eager drivers, a documented
-                # hysteresis lag of the lagged verdict)
+                # the pulled count IS the drained trigger scalar. The
+                # r4-documented one-step hysteresis lag is closed by
+                # the freshness window in step(): while the trigger is
+                # re-armed, the verdict resolves BEFORE the next
+                # dispatch, so the upgrade lands one step earlier.
+                # The first production count closes the window — the
+                # trigger is sticky, later counts only re-confirm.
                 sim._last_iters = int(vals["poisson_iters"])
                 sim._last_iters_dev = None
+                self._trigger_fresh = False
         if self.watchdog is not None:
             self.watchdog.observe(vals)
         if pend.snap is not None:
@@ -612,8 +659,14 @@ class StepGuard:
             self.faults.fire_post_step(pend.step0 + 1)
         # host scalars replace any device originals: a downstream
         # metrics consumer must never pay a SECOND device_get
-        return {**pend.diag, **vals, "step": pend.step0 + 1,
-                "t": sim.time, "dt": dt_used}
+        rec = {**pend.diag, **vals, "step": pend.step0 + 1,
+               "t": sim.time, "dt": dt_used}
+        if pend.mode is not None:
+            # dispatch-time solve-path label (see _Pending.mode): the
+            # recorder prefers this over the live sim property, which
+            # may already reflect a later dispatch's trigger flip
+            rec["poisson_mode"] = pend.mode
+        return rec
 
     def _verdict_from(self, vals: dict, step: int) -> StepVerdict:
         tol = float(getattr(self.sim.cfg, "poisson_tol", 0.0))
@@ -924,8 +977,11 @@ class FleetStepGuard(StepGuard):
             self._replay.append((dts, pend.exact, None))
         if self.faults is not None:
             self.faults.fire_post_step(pend.step0 + 1)
-        return {**pend.diag, **vals, "step": pend.step0 + 1,
-                "t": sim.time, "dt": dts}
+        rec = {**pend.diag, **vals, "step": pend.step0 + 1,
+               "t": sim.time, "dt": dts}
+        if pend.mode is not None:
+            rec["poisson_mode"] = pend.mode   # dispatch-time label
+        return rec
 
     # -- per-member recovery ------------------------------------------
     def _recover_members(self, pend: _Pending, vals: dict,

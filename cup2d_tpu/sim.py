@@ -128,6 +128,11 @@ class Simulation(ShapeHostMixin):
         # synchronous.
         self.async_diag = False
 
+    @property
+    def poisson_mode(self) -> str:
+        """Active solve-path latch (telemetry schema v4)."""
+        return self.grid.poisson_mode
+
     # ------------------------------------------------------------------
     # device: rasterization + chi + integrals (ongrid, main.cpp:4208-4630)
     # ------------------------------------------------------------------
@@ -287,7 +292,8 @@ class Simulation(ShapeHostMixin):
 
         new_state = state._replace(vel=vel, pres=pres, chi=obs.chi,
                                    us=us, udef=udef)
-        return new_state, uvw, g.step_diag(vel, pres, res, div_linf)
+        return new_state, uvw, g.step_diag(vel, pres, res, div_linf,
+                                           exact=exact_poisson)
 
     # ------------------------------------------------------------------
     # device: surface force diagnostics (main.cpp:7188-7284)

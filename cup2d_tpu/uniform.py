@@ -38,6 +38,7 @@ from .poisson import (
     apply_block_precond,
     bicgstab,
     block_precond_matrix,
+    mg_solve,
 )
 
 
@@ -139,6 +140,24 @@ class UniformGrid:
             use_pallas = advect_supported(
                 cfg.bpdy * cfg.bs << lvl, cfg.bpdx * cfg.bs << lvl)
         self.use_pallas = bool(use_pallas)
+        # Poisson solve-path latch (read ONCE here, the AMRSim.__init__
+        # pattern — tests/test_env_latch.py sanctions this site): the
+        # uniform/fleet/sharded-uniform drivers accept "fas"/"fas-f"
+        # (matrix-free FAS multigrid replacing Krylov on production
+        # solves, poisson.mg_solve; -f opens each solve with an
+        # F-cycle); the forest-only tokens (structured/tables/fft) are
+        # valid but inert here so one latched env serves a mixed
+        # process. A typo must fail loudly, not silently measure the
+        # default on both A/B arms.
+        pois = os.environ.get("CUP2D_POIS", "")
+        if pois not in ("", "structured", "tables", "fft",
+                        "fas", "fas-f"):
+            raise ValueError(
+                f"CUP2D_POIS={pois!r}: expected "
+                "structured|tables|fft|fas|fas-f")
+        self.solver_mode = "fas" if pois in ("fas", "fas-f") \
+            else "bicgstab"
+        self.fas_fmg = pois == "fas-f"
         self.level = lvl
         self.nx = cfg.bpdx * cfg.bs << lvl
         self.ny = cfg.bpdy * cfg.bs << lvl
@@ -147,9 +166,19 @@ class UniformGrid:
         self.p_inv = jnp.asarray(block_precond_matrix(cfg.bs), dtype=self.dtype)
         # multigrid V-cycle preconditioner: O(1) Krylov iterations in N,
         # where the reference's single-level block-Jacobi (kept above for
-        # the oracle/AMR paths) degrades linearly in N_1d/BS
-        self.mg = MultigridPreconditioner(self.ny, self.nx, self.dtype,
-                                          spmd_safe=spmd_safe)
+        # the oracle/AMR paths) degrades linearly in N_1d/BS.
+        # The FAS full-solver path runs the cycle at SOLVER precision:
+        # as a preconditioner a bf16 cycle only shapes the error and
+        # flexible BiCGSTAB absorbs the inexactness, but as THE solver
+        # the cycle's floor caps the reachable residual (measured: f32
+        # fields + bf16 cycles stall at ~2e-4 relative, above the 1e-4
+        # bench target). f32 cycles double the per-cycle bytes; the
+        # solve spends 2-4 cycles total vs Krylov's 2 M-applies x 8-11
+        # iterations, so the byte TOTAL still drops.
+        self.mg = MultigridPreconditioner(
+            self.ny, self.nx, self.dtype, spmd_safe=spmd_safe,
+            cycle_dtype=(self.dtype if self.solver_mode == "fas"
+                         else None))
         # f64 dot-product accumulation when fields are f32 AND x64 is
         # available (the Krylov scalars are precision-critical, SURVEY.md §7
         # hard part 5). Without x64, XLA's tree reduction keeps f32 error at
@@ -196,6 +225,26 @@ class UniformGrid:
     def precond(self, r: jnp.ndarray) -> jnp.ndarray:
         return apply_block_precond(r, self.p_inv, self.cfg.bs)
 
+    @property
+    def poisson_mode(self) -> str:
+        """The active solve-path latch, for the telemetry stream
+        (schema v4 ``poisson_mode``)."""
+        if self.solver_mode == "fas":
+            return "fas-f" if self.fas_fmg else "fas"
+        return "bicgstab+mg" if self.cfg.precond else "bicgstab"
+
+    def attach_mesh(self, mesh) -> None:
+        """Give the MG hierarchy the device mesh so the FAS path runs
+        its finest-level smoothing sweeps with the explicit overlapped
+        ppermute exchange (shard_halo.overlap_jacobi_sweeps). No-op on
+        the default Krylov path: its preconditioner cycles stay on the
+        GSPMD form whose sharded==single equality is already pinned."""
+        if self.solver_mode == "fas":
+            self.mg = MultigridPreconditioner(
+                self.ny, self.nx, self.dtype,
+                spmd_safe=self.spmd_safe, mesh=mesh,
+                cycle_dtype=self.dtype)
+
     def pressure_solve(self, rhs: jnp.ndarray, exact: bool = False):
         """Solve lap(dp) = rhs (undivided). ``exact`` reproduces the
         reference's first-10-steps override — tol 0 with 100 restarts
@@ -206,6 +255,19 @@ class UniformGrid:
         the solver's stall detector at whatever the actual precision
         floor is, with a tight refresh cadence so the exit is prompt."""
         cfg = self.cfg
+        if self.solver_mode == "fas" and not exact:
+            # production solves as pure MG cycles (CUP2D_POIS=fas):
+            # 1 A-apply + 1 V-cycle per iteration vs Krylov's 2 + 2.
+            # Exact (tol-0 startup) and escalation solves keep the
+            # Krylov path — its stall-out-at-the-precision-floor
+            # pedigree (r2-r4) is the robustness backstop, and the
+            # unbatched BiCGSTAB stays bit-unchanged.
+            return mg_solve(
+                self.laplacian, rhs, self.mg,
+                tol=cfg.poisson_tol, tol_rel=cfg.poisson_tol_rel,
+                max_cycles=cfg.max_poisson_iterations,
+                fmg=self.fas_fmg,
+            )
         return bicgstab(
             self.laplacian,
             rhs,
@@ -262,7 +324,23 @@ class UniformGrid:
         dv = pressure_gradient_update_fused(pres, h, dt, self.spmd_safe)
         return vel + dv * ih2, pres, res, div_linf
 
-    def step_diag(self, vel, pres, res, div_linf=None) -> dict:
+    def precond_cycles(self, res, exact):
+        """Preconditioner/MG cycle count of one solve (telemetry
+        schema v4), shared by the solo and fleet diag producers so the
+        accounting convention cannot desynchronize between them: FAS
+        iterations ARE cycles; flexible BiCGSTAB applies M twice per
+        iteration; block-Jacobi-only solves report 0 (no hierarchy
+        cycles). A host-derived count would desynchronize from the
+        device iters under the lagged verdict, so this rides the same
+        diag pull as the iters themselves."""
+        if self.solver_mode == "fas" and not exact:
+            return res.iters
+        if self.cfg.precond:
+            return 2 * res.iters
+        return jnp.zeros_like(res.iters)
+
+    def step_diag(self, vel, pres, res, div_linf=None,
+                  exact=False) -> dict:
         umax = jnp.max(jnp.abs(vel))
         # kinetic energy: the telemetry watchdog's first invariant —
         # one extra fused reduction over a field the diag pass reads
@@ -288,6 +366,7 @@ class UniformGrid:
             # riding the same batched diag pull (PR 3)
             "energy": energy,
             "div_linf": div_linf,
+            "precond_cycles": self.precond_cycles(res, exact),
             # next step's dt rides the same device call (no separate
             # dt round trip, r1 weak #10)
             "dt_next": self.dt_from_umax(umax),
@@ -318,7 +397,8 @@ class UniformGrid:
             state.chi if obstacle_terms else None,
             state.udef if obstacle_terms else None, dt, exact_poisson)
         return state._replace(vel=vel, pres=pres), \
-            self.step_diag(vel, pres, res, div_linf)
+            self.step_diag(vel, pres, res, div_linf,
+                           exact=exact_poisson)
 
     def vorticity_field(self, vel: jnp.ndarray) -> jnp.ndarray:
         return vorticity(pad_vector(vel, 1), 1, self.h)
@@ -353,6 +433,11 @@ class UniformSim:
             self.grid.step, donate_argnums=(0,),
             static_argnames=("exact_poisson", "obstacle_terms"))
         self._dt = jax.jit(self.grid.compute_dt)
+
+    @property
+    def poisson_mode(self) -> str:
+        """Active solve-path latch (telemetry schema v4)."""
+        return self.grid.poisson_mode
 
     def step_once(self, dt: Optional[float] = None):
         """One supervised-loop-compatible step (the StepGuard driver

@@ -34,6 +34,7 @@ def test_bench_cpu_smoke():
         BENCH_FLEET="1,2",
         BENCH_FLEET_SIZE="16",
         BENCH_FLEET_STEPS="5",
+        BENCH_POISSON_SIZE="32",         # tiny solver micro-curve
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py")],
@@ -60,6 +61,16 @@ def test_bench_cpu_smoke():
     assert [p["members"] for p in fleet["points"]] == [1, 2]
     assert all(p["member_steps_per_s"] > 0 for p in fleet["points"])
     assert fleet["speedup_vs_b1"] > 0
+    # Poisson solve-path micro-curve (PR 6): every path present with a
+    # real converged solve, so the solver trajectory is tracked in the
+    # BENCH JSON across rounds
+    pc = out["poisson_curve"]
+    assert "error" not in pc, pc
+    assert set(pc["paths"]) == {"bicgstab_jacobi", "bicgstab_mg",
+                                "fas_v", "fas_f"}
+    for name, p in pc["paths"].items():
+        assert p["converged"], (name, p)
+        assert p["iters"] >= 1 and p["ms_per_solve"] > 0, (name, p)
 
 
 @pytest.mark.slow   # ~5 s subprocess; the satellite's tier-1 ask is

@@ -25,10 +25,17 @@ SANCTIONED_FILES = {"config.py", "faults.py"}
 # (file, enclosing scope) -> allowed vars. Each is a construct-once /
 # enable-once latch, grandfathered with its reason:
 SANCTIONED_SITES = {
-    # A/B gates latched per-sim in the constructor (ADVICE r5)
+    # A/B gates latched per-sim in the constructor (ADVICE r5).
+    # CUP2D_POIS mode values: structured|tables|fft on the forest
+    # (AMRSim validates), plus fas|fas-f on the uniform family — the
+    # UniformGrid constructor is the ONE uniform-side latch; fleet.py
+    # and the parallel/ modules read the GRID's stored latch and stay
+    # env-read-free (this walk enforces it).
     ("amr.py", "AMRSim.__init__"): {"CUP2D_POIS", "CUP2D_TWOLEVEL"},
-    # per-grid constructor latch (stored as self.use_pallas)
-    ("uniform.py", "UniformGrid.__init__"): {"CUP2D_PALLAS"},
+    # per-grid constructor latches (stored as self.use_pallas /
+    # self.solver_mode+self.fas_fmg)
+    ("uniform.py", "UniformGrid.__init__"): {"CUP2D_PALLAS",
+                                             "CUP2D_POIS"},
     # read once from ShardedAMRSim.__init__, stored as self._exchange
     ("parallel/forest_mesh.py", "_exchange_mode"):
         {"CUP2D_SHARD_EXCHANGE"},

@@ -6,6 +6,7 @@ import sys
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from cup2d_tpu.config import SimConfig
 from cup2d_tpu.io import dump_uniform, load_checkpoint, read_dump, \
@@ -204,6 +205,12 @@ def test_restore_resets_ordered_cache():
         assert np.array_equal(got, saved_vel)
 
 
+@pytest.mark.slow   # ~11 s of the same AMR disk-case setup as its
+#                     siblings — a NARROWER variant of the tier-1
+#                     test_restore_clears_cached_dt_state (same
+#                     dt-cache-drop contract, adds the field-write-in-
+#                     the-restore-window timing); slow-marked for the
+#                     PR-6 tier-1 budget per the PR-3/5 precedent.
 def test_field_write_after_restore_drops_restored_dt_cache():
     """A forest.fields write in the restore->first-step window must
     still drop the restored dt cache: load_checkpoint re-anchors (not

@@ -55,16 +55,19 @@ def _amr_sim():
 # schema stability (golden key set): every producer emits the SAME keys
 # ---------------------------------------------------------------------------
 
-# the LITERAL schema-v3 key set: METRICS_KEYS is the producers' truth,
+# the LITERAL schema-v4 key set: METRICS_KEYS is the producers' truth,
 # this tuple is the consumers' — any drift between them (a key renamed,
 # dropped, or added without bumping the schema) fails here on purpose.
 # v3 added the fleet-batching fields (fleet_members / member_steps_per_s
-# / member_health, fleet.py).
-_SCHEMA_V3_KEYS = (
+# / member_health, fleet.py); v4 the solve-path attribution pair
+# (poisson_mode — the active CUP2D_POIS latch + trigger state — and the
+# per-step preconditioner-cycle count, PR 6).
+_SCHEMA_V4_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     "umax", "dt_next",
     "poisson_iters", "poisson_residual",
     "poisson_converged", "poisson_stalled",
+    "poisson_mode", "precond_cycles",
     "energy", "div_linf",
     "n_blocks", "blocks_per_level", "refines", "coarsens",
     "halo_real_bytes", "halo_padded_bytes",
@@ -75,10 +78,10 @@ _SCHEMA_V3_KEYS = (
 )
 
 
-def test_metrics_schema_v3_key_set_pinned():
+def test_metrics_schema_v4_key_set_pinned():
     from cup2d_tpu.profiling import METRICS_SCHEMA_VERSION
-    assert METRICS_SCHEMA_VERSION == 3
-    assert METRICS_KEYS == _SCHEMA_V3_KEYS
+    assert METRICS_SCHEMA_VERSION == 4
+    assert METRICS_KEYS == _SCHEMA_V4_KEYS
 
 
 def test_metrics_schema_stable_uniform_amr_bench():
@@ -94,6 +97,11 @@ def test_metrics_schema_stable_uniform_amr_bench():
     assert r["dt"] is not None and r["dt"] > 0
     assert r["energy"] > 0 and r["div_linf"] >= 0
     assert r["n_blocks"] is None        # uniform: AMR fields null
+    # schema v4 solve-path attribution: the driver's latch string and
+    # the cycle count riding the same diag (BiCGSTAB applies the MG
+    # preconditioner twice per iteration)
+    assert r["poisson_mode"] == "bicgstab+mg"
+    assert r["precond_cycles"] == 2 * r["poisson_iters"]
 
     # forest driver path
     asim = _amr_sim()
@@ -105,6 +113,10 @@ def test_metrics_schema_stable_uniform_amr_bench():
     assert ar["n_blocks"] > 0
     assert sum(ar["blocks_per_level"].values()) == ar["n_blocks"]
     assert ar["energy"] > 0
+    # forest attribution: default latch, exact first step = two-level
+    # coarse operand on, 2 M-applies/iter + the x0 = M(b) application
+    assert ar["poisson_mode"] == "bicgstab+jacobi"
+    assert ar["precond_cycles"] == 2 * ar["poisson_iters"] + 1
 
     # bench path (record_step without a sim): same key set, so a
     # BENCH_*.json telemetry block and a run's metrics.jsonl are one

@@ -1,0 +1,151 @@
+"""Forest Poisson solve-path A/B: production iters/step per path.
+
+Builds a near-uniform obstacle-free forest at a chosen block count,
+seeds a multi-scale velocity field (the bench_state recipe on the
+forest), and measures ONE production solve (cold deltap — the
+worst-case production RHS) plus a short warm train under each solve
+path:
+
+  jacobi    block-Jacobi only (trigger off — the sub-15-iters default)
+  additive  two-level additive (the round-5 production form, forced on)
+  mult      two-level multiplicative (coarse first, BJ post)
+  mg2       two-grid cycle: BJ pre-smooth + spectral base-level
+            correction + BJ post-smooth (the CUP2D_POIS=fft form)
+
+Iteration counts are platform-independent (the loop is the same XLA
+program everywhere), so this probe runs anywhere; ms/step numbers are
+only meaningful on the production rig. Usage:
+
+    python -m validation.poisson_ab [--bpd 8] [--steps 4]
+
+Prints one JSON line per path: {path, n_blocks, iters (per step),
+residual, converged}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+
+def build_forest_sim(bpd: int = 8, level_start: int = 2,
+                     dtype: str = "float64", tol: float = 1e-3,
+                     tol_rel: float = 1e-2):
+    """Obstacle-free AMRSim on the uniform level_start grid
+    (bpd*2^level_start squared blocks), regridding disabled, seeded
+    with the bench's multi-scale divergence-bearing field."""
+    import jax.numpy as jnp
+
+    from cup2d_tpu.amr import AMRSim
+    from cup2d_tpu.config import SimConfig
+
+    cfg = SimConfig(bpdx=bpd, bpdy=bpd, level_max=level_start + 1,
+                    level_start=level_start, extent=1.0, nu=4e-5,
+                    cfl=0.5, dtype=dtype, rtol=1e9, ctol=-1.0,
+                    poisson_tol=tol, poisson_tol_rel=tol_rel,
+                    max_poisson_iterations=2000)
+    sim = AMRSim(cfg)
+    f = sim.forest
+    bs = cfg.bs
+    vals = np.zeros((f.capacity, 2, bs, bs))
+    n1d = bpd * bs << level_start
+    m = max(n1d // 64, 8)
+    for (l, i, j), s in f.blocks.items():
+        h = cfg.h_at(l)
+        x = (i * bs + np.arange(bs) + 0.5) * h
+        y = (j * bs + np.arange(bs) + 0.5) * h
+        X, Y = np.meshgrid(x, y, indexing="xy")
+        xs, ys = np.pi * X, np.pi * Y
+        vals[s, 0] = (np.sin(xs) * np.cos(ys)
+                      + 0.25 * np.sin(8 * xs) * np.cos(8 * ys)
+                      + 0.3 * np.sin(m * xs) * np.sin(m * ys))
+        vals[s, 1] = (-np.cos(xs) * np.sin(ys)
+                      + 0.25 * np.sin(16 * ys) * np.sin(16 * xs)
+                      + 0.3 * np.sin(m * ys) * np.sin(m * xs))
+    f.fields["vel"] = jnp.asarray(vals)
+    sim.step_count = 20          # production regime (no exact override)
+    return sim
+
+
+def build_synthetic_sim(target: int, levelmax: int = 8):
+    """The BASELINE.md 1e4-block-regime forest (scale_proof's synthetic
+    vortices on the canonical domain, levelStart 6), adapted until
+    ``target`` blocks are active — the same topology class the r4/r5
+    production-iteration numbers were measured on."""
+    from types import SimpleNamespace
+
+    from validation.scale_proof import _synthetic_sim
+
+    sim = _synthetic_sim(SimpleNamespace(levelmax=levelmax, rtol=0.05))
+    while len(sim.forest.blocks) < target and sim.adapt():
+        pass
+    sim.step_count = 20
+    return sim
+
+
+def run_path(path: str, bpd: int, steps: int, synthetic: int = 0,
+             levelmax: int = 8) -> dict:
+    """Fresh sim per path so no state leaks between arms."""
+    if synthetic:
+        sim = build_synthetic_sim(synthetic, levelmax)
+    else:
+        sim = build_forest_sim(bpd=bpd)
+    # build tables/maps BEFORE pinning the path: _refresh_impl re-arms
+    # the trigger (coarse_on = False), which would silently turn the
+    # first measured solve into the jacobi arm on every path
+    sim._refresh()
+    if path == "jacobi":
+        sim._coarse_on = False       # the trigger-off default
+        use = False
+    else:
+        sim._twolevel_form = path    # the latched A/B slot
+        sim._coarse_on = True        # force-engage the correction
+        use = True
+    iters, res, conv = [], [], []
+    dt = None
+    for _ in range(steps):
+        # keep the trigger state pinned: this is an A/B arm, the
+        # sticky iters>15 trigger must not flip it mid-train. Pinning
+        # _coarse_on alone is NOT enough — _use_coarse re-engages off
+        # sim._last_iters (>15 after any rough step), which would
+        # silently turn the jacobi arm's steps 2..N into two-level
+        # measurements — so the trigger EVIDENCE is zeroed too.
+        sim._coarse_on = use
+        sim._last_iters = 0
+        sim._last_iters_dev = None
+        d = sim.step_once(dt)
+        iters.append(int(d["poisson_iters"]))
+        res.append(float(d["poisson_residual"]))
+        conv.append(bool(d["poisson_converged"]))
+    return {
+        "path": path,
+        "n_blocks": int(sim._n_real),
+        "iters": iters,
+        "residual": res,
+        "converged": conv,
+    }
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bpd", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--paths", default="jacobi,additive,mult,mg2")
+    ap.add_argument("--synthetic", type=int, default=0,
+                    help="use the BASELINE 1e4-regime synthetic forest "
+                         "adapted to >= this many blocks")
+    ap.add_argument("--levelmax", type=int, default=8)
+    args = ap.parse_args()
+    for path in args.paths.split(","):
+        print(json.dumps(run_path(path, args.bpd, args.steps,
+                                  synthetic=args.synthetic,
+                                  levelmax=args.levelmax)),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
