@@ -24,6 +24,27 @@ own CFL dt; dumps write one reference-format triplet per member,
 ``vel.NNNNNNNN.mK``. Obstacle-free only: ``-shapes`` with ``-fleet``
 is an error).
 
+MULTI-DEVICE & ELASTIC (parallel/, PR 7): ``-mesh N|all`` runs the
+sharded drivers (ShardedUniformSim / ShardedAMRSim) over an N-device
+(or every-device) 1-D mesh; multi-process bring-up takes
+``-coordinator HOST:PORT -meshHosts P -processId I`` (TPU pods
+autodetect all three) with the coordinator connect budget on
+``-connectAttempts`` / ``-connectBackoff`` (argv-latched at the
+init_distributed call — never a scattered env read). ``-elastic`` arms
+the TopologyGuard: a bounded-timeout heartbeat piggybacked on the
+step-boundary SIGTERM collective (``-heartbeatTimeout S``, a host lost
+after ``-heartbeatMissK K`` missed beats), survivors agreeing on the
+same shrunk device set from the same evidence. On a SIMULATED topology
+(``-simHosts H`` groups the virtual devices of a single-process run
+into H hosts; losses injected by CUP2D_FAULTS host_exit@N /
+host_hang@N) recovery is fully in place: re-mesh the survivors, resume
+from the device snapshot ring (disk where the ring does not cover),
+continue — no relaunch. On a REAL pod the CLI today gives bounded
+detection + an orderly abort (the old behavior was an indefinite
+hang); the in-place runtime re-init (launch.reinit_distributed) is
+library-level, pending a working multi-process runtime to validate
+against (ROADMAP).
+
 The run loop is SUPERVISED (resilience.py): every step's health verdict
 rides the diagnostics the step already pulls, a bad step walks the
 rewind/escalate/disk-restore/abort ladder, SIGTERM checkpoints at the
@@ -94,10 +115,43 @@ def main(argv=None) -> int:
     set_event_log(log)                   # io/launch fallback events
     tracer = TraceWindow.from_env()      # CUP2D_TRACE, latched once
 
+    # multi-device mesh (+ optional multi-process bring-up). The
+    # connect budget is argv-latched HERE and passed down — satellite
+    # of the elastic work: a scattered env read would be exactly the
+    # mid-run-mutation hazard the CUP2D_* latch rule exists for.
+    mesh = None
+    if p.has("mesh"):
+        from .parallel.launch import global_mesh, init_distributed
+        from .parallel.mesh import make_mesh
+        init_distributed(
+            coordinator_address=(p("coordinator").asString()
+                                 if p.has("coordinator") else None),
+            num_processes=(p("meshHosts").asInt()
+                           if p.has("meshHosts") else None),
+            process_id=(p("processId").asInt()
+                        if p.has("processId") else None),
+            expected_processes=(p("meshHosts").asInt()
+                                if p.has("meshHosts") else None),
+            connect_attempts=(p("connectAttempts").asInt()
+                              if p.has("connectAttempts") else 5),
+            connect_backoff=(p("connectBackoff").asDouble()
+                             if p.has("connectBackoff") else 1.0))
+        spec = p("mesh").asString()
+        mesh = global_mesh() if spec == "all" else make_mesh(int(spec))
+    if p.has("elastic") and (mesh is None or mesh.devices.size < 2):
+        print("cup2d_tpu: -elastic needs -mesh with at least 2 devices "
+              "(nothing to re-mesh onto otherwise)", file=sys.stderr)
+        return 2
+
     if fleet_n:
         if cfg.shapes:
             print("cup2d_tpu: -fleet supports obstacle-free uniform "
                   "runs only (shapes given)", file=sys.stderr)
+            return 2
+        if mesh is not None:
+            print("cup2d_tpu: -fleet has its own placement policy "
+                  "(fleet.py) and does not combine with -mesh",
+                  file=sys.stderr)
             return 2
         from .fleet import FleetSim
         level = p("level").asInt() if p.has("level") else cfg.level_start
@@ -108,19 +162,39 @@ def main(argv=None) -> int:
             # -> per-member dt, the no-lockstep contract live)
             sim.seed_taylor_green()
     elif uniform:
-        from .sim import Simulation
         level = p("level").asInt() if p.has("level") else cfg.level_start
-        sim = Simulation(cfg, level=level)
+        if mesh is not None:
+            if cfg.shapes:
+                print("cup2d_tpu: -mesh on the uniform path is "
+                      "obstacle-free only (ShardedUniformSim)",
+                      file=sys.stderr)
+                return 2
+            from .parallel.mesh import ShardedUniformSim
+            from .uniform import taylor_green_state
+            sim = ShardedUniformSim(cfg, mesh, level=level)
+            if not p.has("restart"):
+                # same rationale as the fleet seed: an obstacle-free
+                # zero state is a trivial run
+                sim.set_state(taylor_green_state(sim.grid))
+        else:
+            from .sim import Simulation
+            sim = Simulation(cfg, level=level)
     else:
-        from .amr import AMRSim
-        sim = AMRSim(cfg)
+        if mesh is not None:
+            from .parallel.forest_mesh import ShardedAMRSim
+            sim = ShardedAMRSim(cfg, mesh)
+        else:
+            from .amr import AMRSim
+            sim = AMRSim(cfg)
     if p.has("restart"):
         load_checkpoint(p("restart").asString(), sim)
     if p.has("profile"):
         from .profiling import PhaseTimers
         sim.timers = PhaseTimers()
 
-    if not fleet_n:
+    if not fleet_n and hasattr(type(sim), "force_log_header"):
+        # the obstacle-free sharded driver (ShardedUniformSim) computes
+        # no body forces — there is nothing to log, like the fleet
         force_path = os.path.join(outdir, "forces.csv")
         resuming = p.has("restart") and os.path.exists(force_path)
         sim.force_log = open(force_path, "a" if resuming else "w")
@@ -148,6 +222,29 @@ def main(argv=None) -> int:
             dump_forest(path, sim.time, sim.forest)
 
     ckpt_path = os.path.join(outdir, "checkpoint")
+    topo = None
+    if p.has("elastic"):
+        from .resilience import TopologyGuard, dist_initialized
+        if not p.has("simHosts") and not dist_initialized():
+            # single process without a simulated topology: the "real"
+            # heartbeat would watch a 1-host world whose only possible
+            # loss is this process itself — a host_exit fault would be
+            # misdiagnosed as a pod losing its host. Usage error, not
+            # a runtime misdiagnosis.
+            print("cup2d_tpu: -elastic on a single-process run needs "
+                  "-simHosts H (H >= 2) to stand up a simulated "
+                  "topology; real heartbeats need a multi-process "
+                  "bring-up (-coordinator/-meshHosts)", file=sys.stderr)
+            return 2
+        topo = TopologyGuard(
+            devices=list(mesh.devices.flat),
+            sim_hosts=(p("simHosts").asInt()
+                       if p.has("simHosts") else None),
+            miss_k=(p("heartbeatMissK").asInt()
+                    if p.has("heartbeatMissK") else 3),
+            timeout=(p("heartbeatTimeout").asDouble()
+                     if p.has("heartbeatTimeout") else 10.0),
+            faults=plan, event_log=log)
     guard_cls = FleetStepGuard if fleet_n else StepGuard
     guard = guard_cls(
         sim,
@@ -227,8 +324,46 @@ def main(argv=None) -> int:
             # agree() is a min-allreduce of the SIGTERM latch on pods
             # (all hosts enter the collective save at the same step —
             # the former ROADMAP pod gap (a)); single-host it is just
-            # the local flag
-            if stop.agree():
+            # the local flag. With -elastic the SAME step-boundary
+            # collective carries the heartbeat (TopologyGuard — one
+            # bounded allgather instead of two).
+            if topo is not None:
+                beat = topo.step_boundary(stop, sim.step_count)
+                if beat.self_lost:
+                    # real-mode host_exit fault: die like a lost host
+                    # would — hard, immediately, writing nothing (the
+                    # survivors' detection drill)
+                    os._exit(17)
+                if beat.hung:
+                    print("cup2d_tpu: heartbeat collective missed its "
+                          f"{topo.timeout:.1f}s deadline at step "
+                          f"{sim.step_count} — a peer died mid-step. "
+                          "The old world's collectives are unusable; "
+                          "in-place resume needs a runtime re-init "
+                          "(parallel.launch.reinit_distributed, "
+                          "orchestrator-driven). Aborting with the "
+                          "last checkpoint intact.", file=sys.stderr)
+                    return 1
+                if beat.lost:
+                    if topo.sim_hosts is None:
+                        # real pod: detection is bounded, but in-place
+                        # resume additionally needs the runtime re-init
+                        # (see the hang branch) — orderly abort beats
+                        # the pre-elastic indefinite hang
+                        print(f"cup2d_tpu: hosts {list(beat.lost)} "
+                              "left the program — aborting (in-place "
+                              "pod resume pending a validated "
+                              "reinit_distributed path, ROADMAP)",
+                              file=sys.stderr)
+                        return 1
+                    # simulated topology: re-mesh the survivors and
+                    # resume from the snapshot ring / disk, in place
+                    guard.elastic_recover(topo)
+                    continue
+                stop_now = beat.stop
+            else:
+                stop_now = stop.agree()
+            if stop_now:
                 drain()
                 save_checkpoint(ckpt_path, sim)
                 log.emit(event="sigterm_checkpoint", step=sim.step_count,

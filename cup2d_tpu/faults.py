@@ -28,6 +28,25 @@ Spec syntax — comma-separated directives, ``name[@STEP][*COUNT]``::
     crash_in_save         raise :class:`InjectedCrash` between the
                           checkpoint park and install renames
                           (io.save_checkpoint's crash window)
+    host_exit@N           topology loss (graceful flavor): a host leaves
+                          the SPMD program at step N's boundary. On a
+                          SIMULATED topology (resilience.TopologyGuard
+                          with sim_hosts=H) the highest-index alive
+                          simulated host is marked dead — the tier-1
+                          elastic drill; in a REAL multi-process run
+                          the directive is process-scoped like
+                          sigterm@N: THIS process announces exit in its
+                          final heartbeat, then hard-exits (os._exit —
+                          a dead host writes nothing)
+    host_hang@N           topology loss (hard flavor): the host stops
+                          heartbeating without an announcement —
+                          simulated hosts just miss beats; a real
+                          process blocks forever inside its next step
+                          boundary, so the survivors' bounded
+                          collective hits its deadline (the watchdog
+                          path). Host-loss tokens are CONSUMED by the
+                          TopologyGuard (resilience.py); without an
+                          elastic guard they never fire.
 
 ``*K`` repeats the fault for K consecutive attempts of that step, which
 is how a test climbs the ladder: ``*1`` recovers at the rewind-retry
@@ -65,6 +84,7 @@ class FaultPlan:
         self.giveup: dict[int, int] = {}        # step -> count
         self.sigterm_steps: set[int] = set()
         self.crash_points: dict[str, int] = {}  # name -> count
+        self.host_loss: dict[int, list] = {}    # step -> ["exit"|"hang"]
         # replay suspension (StepGuard.snapshot-cadence recovery): a
         # restore-and-replay re-runs ALREADY-VERDICTED-GOOD steps, so
         # an armed *K fault whose step lands mid-replay must not fire
@@ -102,11 +122,16 @@ class FaultPlan:
                 self.sigterm_steps.add(step)
             elif name == "crash_in_save":
                 self.crash_points["checkpoint_install"] = count
+            elif name in ("host_exit", "host_hang"):
+                if step is None:
+                    raise ValueError(f"{name} needs @STEP")
+                self.host_loss.setdefault(step, []).append(
+                    name.split("_", 1)[1])
             else:
                 raise ValueError(
                     f"unknown fault directive {name!r} "
                     "(expected nan_vel|inf_vel|scale_vel|poisson_giveup|"
-                    "sigterm|crash_in_save)")
+                    "sigterm|crash_in_save|host_exit|host_hang)")
 
     @classmethod
     def from_env(cls) -> "FaultPlan":
@@ -115,7 +140,8 @@ class FaultPlan:
 
     def __bool__(self) -> bool:
         return bool(self.vel_poison or self.vel_scale or self.giveup
-                    or self.sigterm_steps or self.crash_points)
+                    or self.sigterm_steps or self.crash_points
+                    or self.host_loss)
 
     # -- replay suspension --------------------------------------------
     @contextlib.contextmanager
@@ -174,6 +200,15 @@ class FaultPlan:
         if step in self.sigterm_steps:
             self.sigterm_steps.discard(step)
             os.kill(os.getpid(), signal.SIGTERM)
+
+    def host_loss_at(self, step: int) -> list:
+        """Consume the host-loss directives armed for ``step`` (the
+        TopologyGuard's per-boundary lookup — 'exit'/'hang' kinds).
+        Suspended during guard replay like every other injector: a
+        restore-and-replay must not lose a host twice."""
+        if self._suspended:
+            return []
+        return self.host_loss.pop(step, [])
 
     def fire_crash_point(self, name: str) -> None:
         c = self.crash_points.get(name, 0)

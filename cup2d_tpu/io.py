@@ -660,3 +660,63 @@ def restore_snapshot_device(sim, snap: DeviceSnapshot) -> None:
     if getattr(sim, "shapes", None) and snap.shapes_pkl is not None:
         sim.shapes[:] = pickle.loads(snap.shapes_pkl)
         sim._initialized = True
+
+
+# ---------------------------------------------------------------------------
+# elastic topology resume (PR 7): snapshot coverage + re-sharding restore
+# ---------------------------------------------------------------------------
+
+def snapshot_covers(snap, lost_processes=()) -> bool:
+    """True iff a :class:`DeviceSnapshot` can seed an elastic resume
+    after a topology loss: every payload shard must still be readable.
+
+    The snapshot payload is per-shard-local device copies (the module
+    note above), so the rule is addressability: a shard held only by a
+    LOST process died with it, and on a multi-host pod each process
+    only ever addresses its own shards — a real host loss therefore
+    fails this check for any cross-host-sharded state, and the elastic
+    path falls back to the disk checkpoint (whose save was a collective
+    gather to shared storage). SIMULATED topologies (a single process
+    whose virtual devices are grouped into fake hosts,
+    resilience.TopologyGuard(sim_hosts=...)) keep every shard
+    addressable — the in-HBM resume path the tier-1 drill exercises
+    end-to-end."""
+    import jax
+
+    lost = set(lost_processes)
+    for v in snap.payload.values():
+        if isinstance(v, jax.Array):
+            if not v.is_fully_addressable:
+                return False
+            if lost and any(d.process_index in lost
+                            for d in v.sharding.device_set):
+                return False
+    return True
+
+
+def restore_snapshot_resharded(sim, snap: "DeviceSnapshot") -> None:
+    """Install a device snapshot into a sim whose MESH changed since
+    capture (resilience.StepGuard.elastic_recover, after
+    ``sim.remesh``): the standard device-to-device install first (its
+    copies land with the capture-time placement), then every field is
+    re-placed onto the sim's current mesh — the re-shard the elastic
+    resume owes the rebuilt step executable. Works for both families:
+    the uniform state goes back through ``set_state`` (the placement
+    authority), the forest's ordered working state through
+    ``_put_ordered``. Only valid where :func:`snapshot_covers` said so
+    — re-sharding reads every source shard."""
+    restore_snapshot_device(sim, snap)
+    if hasattr(sim, "forest"):
+        if sim._ord is not None:
+            sim._ord = {k: sim._put_ordered(v)
+                        for k, v in sim._ord.items()}
+    elif hasattr(sim, "set_state"):
+        sim.set_state(sim.state)
+    nd = getattr(sim, "_next_dt", None)
+    if nd is not None and hasattr(nd, "sharding"):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = getattr(sim, "mesh", None)
+        if mesh is not None:
+            sim._next_dt = jax.device_put(
+                nd, NamedSharding(mesh, PartitionSpec()))

@@ -64,6 +64,53 @@ class ShardedAMRSim(AMRSim):
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(self.mesh, P("x")))
 
+    def remesh(self, mesh: Mesh) -> None:
+        """Elastic re-mesh (resilience.StepGuard.elastic_recover):
+        re-partition the SFC block ranges over a new — typically
+        shrunk — device set, in place. The block partition is
+        device-count-parametric by construction (each device owns an
+        equal contiguous range of the padded ordered axis), so the
+        re-mesh is a table rebuild + re-placement, no topology change:
+        ``_refresh`` is forced even though ``forest.version`` did not
+        move, which rebuilds every per-device table plan / exchange
+        plan / Poisson operator against the new mesh (falling back to
+        replicated tables when the survivor count no longer divides
+        the pad bucket — the same degradation rule as construction),
+        re-places the slot fields, and refreshes the comm-volume
+        telemetry. The jitted stages retrace automatically: the table
+        pytrees carry the mesh as static aux data (shard_halo), so a
+        new mesh is a new cache key. The ordered working state — the
+        hot-loop truth — is re-placed last.
+
+        Real-loss guard: field shards a dead peer took with it cannot
+        be re-placed (the device_put would read them) — they are
+        zeroed first; the disk restore that follows a non-covering
+        loss overwrites them wholesale, and the ordered-state cache is
+        dropped with them."""
+        f = self.forest
+        if not all(getattr(v, "is_fully_addressable", True)
+                   for v in f.fields.values()):
+            for name, fld in list(f.fields.items()):
+                f.fields[name] = jnp.zeros(fld.shape, fld.dtype)
+            self._ord = None
+            self._ord_dirty = False
+            self._ord_key = None
+        self.mesh = mesh
+        self._tables_version = -1    # force the rebuild path
+        self._refresh()
+        if self._ord is not None:
+            self._ord = {k: self._put_ordered(v)
+                         for k, v in self._ord.items()}
+            # re-anchor at the post-re-placement write version: the
+            # device_puts above and in _refresh bump fields.wver, but a
+            # PLACEMENT move is not a semantic field write — without
+            # the re-anchor the next _ordered_state() would take the
+            # wver-moved branch, re-gather from the (possibly stale)
+            # slot fields and drop the dt cache. _ord_dirty is kept
+            # as-is: whether the slots are stale is unchanged by where
+            # the ordered arrays live.
+            self._ord_key = (self.forest.version, self.forest.fields.wver)
+
     def _refresh(self):
         f = self.forest
         if self._tables_version == f.version:

@@ -67,16 +67,15 @@ from .mesh import make_mesh
 
 
 def _dist_initialized() -> bool:
-    """jax.distributed.is_initialized with a fallback for the image's
-    jax 0.4.x line (the accessor landed later): the runtime is up iff
-    the global distributed client exists. Same check, private spelling
-    — and it never touches the XLA backend, preserving this module's
-    no-probe contract."""
-    probe = getattr(jax.distributed, "is_initialized", None)
-    if probe is not None:
-        return bool(probe())
-    from jax._src import distributed as _dist
-    return _dist.global_state.client is not None
+    """Version-safe, public-API-only check that the distributed runtime
+    is up (resilience.dist_initialized): the public
+    ``jax.distributed.is_initialized`` accessor where the build has it,
+    else the latch ``init_distributed`` sets below. Never touches the
+    XLA backend, preserving this module's no-probe contract. (The
+    former fallback read ``jax._src.distributed.global_state.client``
+    — a private attribute that moves between versions.)"""
+    from ..resilience import dist_initialized
+    return dist_initialized()
 
 
 def _connect_with_retry(connect: Callable[[], None],
@@ -137,8 +136,13 @@ def init_distributed(coordinator_address: Optional[str] = None,
 
     The connect itself retries with bounded exponential backoff
     (``connect_attempts`` tries, ``connect_backoff`` * 2^k seconds
-    apart, logged) — see :func:`_connect_with_retry`.
+    apart, logged) — see :func:`_connect_with_retry`. Both knobs are
+    plumbed from the CLI (``-connectAttempts`` / ``-connectBackoff``,
+    latched once from argv at this call — never a scattered env read),
+    and the elastic re-init path (:func:`reinit_distributed`) takes its
+    OWN budget rather than inheriting this first-launch one.
     """
+    from ..resilience import note_distributed_initialized
     if _dist_initialized():
         rank = jax.process_index()
     else:
@@ -157,12 +161,60 @@ def init_distributed(coordinator_address: Optional[str] = None,
                 num_processes=num_processes,
                 process_id=process_id),
             attempts=connect_attempts, backoff=connect_backoff)
+        note_distributed_initialized()   # version-safe probe latch
         rank = jax.process_index()
     if expected_processes and jax.process_count() != expected_processes:
         raise RuntimeError(
             f"distributed runtime has {jax.process_count()} processes, "
             f"expected {expected_processes} — partial pod bring-up")
     return rank
+
+
+def reinit_distributed(coordinator_address: str,
+                       num_processes: int,
+                       process_id: int,
+                       connect_attempts: int = 10,
+                       connect_backoff: float = 0.5) -> int:
+    """Tear down and re-initialize the distributed runtime over the
+    SURVIVOR world after a topology loss (resilience.TopologyGuard) —
+    the runtime half of elastic recovery: once a peer is gone, every
+    collective of the OLD world hangs, so the survivors must agree on a
+    new (smaller) world before the re-meshed step can run.
+
+    The connect budget is deliberately separate from
+    :func:`init_distributed`'s first-launch one: a re-init races only
+    the other survivors (already up, already agreed on the new world
+    from the same beat evidence), so it wants more attempts at shorter
+    backoff than a cold pod bring-up waiting on a scheduler. The
+    coordinator address must name a SURVIVOR (by the determinism rule
+    the new process 0 — survivors renumber by rank order), on a fresh
+    port: the old coordinator service may be gone, or its port still
+    parked in TIME_WAIT.
+
+    Exercised by the slow-marked 2-process drill
+    (tests/_multihost_worker.py); environment-broken in this container
+    like the rest of the multi-process harness (ROADMAP)."""
+    from ..resilience import note_distributed_initialized, record_event
+    if _dist_initialized():
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:
+            # a shutdown against a world with a dead member can itself
+            # fail — log and proceed: initialize() below is the
+            # authority on whether the new world comes up
+            print(f"cup2d_tpu: distributed shutdown during re-init "
+                  f"failed: {e}", file=sys.stderr)
+            record_event(event="reinit_shutdown_failed", error=str(e))
+    _connect_with_retry(
+        lambda: jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id),
+        attempts=connect_attempts, backoff=connect_backoff)
+    note_distributed_initialized()
+    record_event(event="reinit_distributed",
+                 num_processes=num_processes, process_id=process_id)
+    return jax.process_index()
 
 
 def _in_tpu_pod() -> bool:

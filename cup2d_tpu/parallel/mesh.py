@@ -84,12 +84,18 @@ class ShardedUniformSim(UniformSim):
         # which miscompiles the fast pad+slice zero-shift form
         # (ops/stencil._zshift)
         super().__init__(cfg, level, spmd_safe=True)
-        self.mesh = mesh
+        self._bind_mesh(mesh)
+
+    def _bind_mesh(self, mesh: Mesh) -> None:
+        """Point every mesh-derived artifact at ``mesh`` and rebuild
+        the step executable: shared by construction and by the elastic
+        :meth:`remesh`."""
         if self.grid.nx % mesh.devices.size != 0:
             raise ValueError(
                 f"Nx={self.grid.nx} not divisible by mesh size "
                 f"{mesh.devices.size}"
             )
+        self.mesh = mesh
         # FAS solve path (CUP2D_POIS=fas, latched in UniformGrid):
         # rebuild the MG hierarchy mesh-aware so its finest-level
         # smoothing sweeps run the comm/compute-overlapped shard_map
@@ -112,6 +118,31 @@ class ShardedUniformSim(UniformSim):
             static_argnames=("exact_poisson", "obstacle_terms"),
             out_shardings=(state_shardings, None),
         )
+
+    def remesh(self, mesh: Mesh) -> None:
+        """Elastic re-mesh (resilience.StepGuard.elastic_recover):
+        rebuild placement + the step executable over a new — typically
+        shrunk — device set, in place, without relaunch. The current
+        state is re-placed onto the new mesh (an XLA reshard); the
+        elastic path immediately overwrites it from the snapshot ring /
+        disk checkpoint, so its value never matters there. Cached
+        device scalars (the async drivers' ``_next_dt``) are re-placed
+        too — a replicated scalar pinned to a LOST device must not leak
+        into the rebuilt executable's argument stream.
+
+        Real-loss guard: when the current state's shards are no longer
+        fully addressable (a peer process died and took them — the
+        disk-rung path), re-sharding would try to READ them; the state
+        is zeroed instead, since the restore that follows overwrites it
+        wholesale."""
+        if not all(getattr(v, "is_fully_addressable", True)
+                   for v in self.state):
+            self.state = self.grid.zero_state()
+            self._next_dt = None
+        self._bind_mesh(mesh)
+        if isinstance(self._next_dt, jax.Array):
+            self._next_dt = jax.device_put(
+                self._next_dt, NamedSharding(mesh, P()))
 
     def set_state(self, state: FlowState):
         self.state = shard_state(state, self.mesh)

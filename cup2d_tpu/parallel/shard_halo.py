@@ -33,6 +33,17 @@ Per-device row counts and surface sizes are padded to power-of-two
 buckets so regrids reuse compiled executables (same rationale as
 halo.pad_tables); surface buckets are per offset so pod-scale meshes
 don't pay the worst pair's bucket on every pair.
+
+Elastic re-mesh contract (PR 7): every plan here is a pure function of
+(raw host tables, n_pad, mesh), and the table pytrees carry the mesh —
+with every mesh-derived static (offsets, perms, B, S) — as STATIC aux
+data (the register_pytree_node below). A survivor re-mesh after a
+topology loss (forest_mesh.ShardedAMRSim.remesh) therefore just
+rebuilds the plans against the shrunk mesh and the jitted stages
+retrace on the new treedef — no stale-mesh executable can ever be
+reused, by construction. When the survivor count no longer divides the
+pad bucket, the callers fall back to replicated tables exactly as they
+would at construction.
 """
 
 from __future__ import annotations

@@ -345,7 +345,7 @@ class HostCounters:
 # always present; fields that do not apply to a path (AMR shape on a
 # uniform run, comm volume on a single device, counters when disabled)
 # are null — consumers key on names, never on presence.
-METRICS_SCHEMA_VERSION = 4
+METRICS_SCHEMA_VERSION = 5
 METRICS_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     # solver health + timestep state (the step's existing diag pull).
@@ -376,6 +376,13 @@ METRICS_KEYS = (
     # (absolute bytes) + replayed-step delta of the snapshot-cadence
     # recovery path — the D2H win made visible in post --metrics
     "snap_ring_bytes", "replayed_steps",
+    # elastic topology (schema v5, PR 7): the current topology epoch
+    # (bumped by each survivor re-mesh agreement — 0 for a run that
+    # never lost a host), the cumulative re-mesh count, and the wall
+    # cost of any re-mesh that landed since the previous record (null
+    # on ordinary steps) — a topology loss and its recovery are
+    # attributable from metrics.jsonl alone
+    "topology_epoch", "remesh_count", "remesh_ms",
     # fleet batching (schema v3, fleet.py): member count of the fused
     # dispatch, its throughput in member-steps/s (B / wall of the one
     # dispatch — THE dispatch-amortization metric), and per-member
@@ -447,6 +454,7 @@ class MetricsRecorder:
         self._last_phase: dict = dict(timers.acc) if timers else {}
         self._last_regrid = (0, 0)
         self._last_replayed = 0
+        self._last_remesh_ms = 0.0
         self._lvl_cache = (None, None, None)   # (version, hist, n)
 
     def prime(self, sim) -> None:
@@ -568,15 +576,29 @@ class MetricsRecorder:
 
     def _guard_fields(self) -> dict:
         """Supervision telemetry: the device snapshot ring's HBM bytes
-        (absolute — host metadata on the arrays, no sync) and the
-        replayed-step delta of the snapshot-cadence recovery path."""
+        (absolute — host metadata on the arrays, no sync), the
+        replayed-step delta of the snapshot-cadence recovery path, and
+        the elastic-topology group (schema v5): epoch / cumulative
+        re-mesh count / per-record re-mesh wall cost, all host state on
+        the guard."""
         if self.guard is None:
-            return {"snap_ring_bytes": None, "replayed_steps": None}
+            return {"snap_ring_bytes": None, "replayed_steps": None,
+                    "topology_epoch": None, "remesh_count": None,
+                    "remesh_ms": None}
         cur = int(getattr(self.guard, "replayed_steps", 0))
         delta = cur - self._last_replayed
         self._last_replayed = cur
+        ms_total = float(getattr(self.guard, "remesh_ms_total", 0.0))
+        ms_delta = ms_total - self._last_remesh_ms
+        self._last_remesh_ms = ms_total
         return {"snap_ring_bytes": int(self.guard.ring_nbytes()),
-                "replayed_steps": delta}
+                "replayed_steps": delta,
+                "topology_epoch": int(
+                    getattr(self.guard, "topology_epoch", 0)),
+                "remesh_count": int(
+                    getattr(self.guard, "remesh_count", 0)),
+                "remesh_ms": (round(ms_delta, 3)
+                              if ms_delta > 0 else None)}
 
     def _phase_fields(self) -> Optional[dict]:
         if self.timers is None:
@@ -655,6 +677,12 @@ def summarize_metrics(records: list) -> dict:
                             if col("snap_ring_bytes") else None),
         "replayed_steps_total": (sum(col("replayed_steps"))
                                  if col("replayed_steps") else None),
+        # elastic topology (schema v5): a run that never lost a host
+        # reports epoch 0 / 0 re-meshes
+        "topology_epoch": (col("topology_epoch")[-1]
+                           if col("topology_epoch") else None),
+        "remesh_count": (col("remesh_count")[-1]
+                         if col("remesh_count") else None),
         # fleet batching (schema v3): member count + the
         # dispatch-amortization throughput metric
         "fleet_members": (col("fleet_members")[-1]

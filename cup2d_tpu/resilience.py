@@ -69,9 +69,42 @@ min-allreduces the flag at every step boundary, so all hosts enter the
 collective checkpoint at the same step (the former ROADMAP pod gap
 (a); drilled by the skewed-delivery phase of the multihost harness).
 
-Known non-recoverable failure classes are listed in ROADMAP.md "Open
-items" (e.g. losing a process mid-collective changes the topology under
-the SPMD program; only a full restart from disk recovers that).
+Topology-changing loss (the one failure class the ladder above cannot
+touch — a host or process dropping OUT of the SPMD program) is handled
+by the elastic subsystem (PR 7):
+
+- :class:`TopologyGuard`: detection + agreement. The heartbeat
+  piggybacks on the step-boundary collective the run already pays
+  (:meth:`PreemptionGuard.agree`'s one-int allgather grows to a
+  three-int payload: SIGTERM latch, topology epoch, exiting flag) and
+  is BOUNDED — the collective runs under a deadline, so a peer that
+  died mid-step surfaces as a timeout instead of an infinite hang. A
+  host that misses ``miss_k`` consecutive beats (or announces a
+  graceful exit in its last beat) is DECLARED lost; every survivor
+  computes the same new device set from the same allgathered evidence
+  and bumps the same epoch counter — the deterministic agreement that
+  keeps the re-mesh collective-safe. Single-process runs can stand up
+  a SIMULATED topology (``sim_hosts=H`` groups the virtual devices
+  into H hosts) whose losses are injected by ``faults.py``
+  ``host_exit@N`` / ``host_hang@N`` directives — the tier-1 drill.
+- :meth:`StepGuard.elastic_recover`: re-mesh + resume. Survivor
+  devices become a fresh mesh (``parallel.mesh.make_mesh``), the sim
+  rebuilds its placement/tables/step executable over it
+  (``sim.remesh``), and the state comes from the device snapshot ring
+  where the surviving shards still cover it (``io.snapshot_covers`` —
+  re-sharded onto the new mesh by ``io.restore_snapshot_resharded``),
+  falling back to the last disk checkpoint otherwise. No process
+  relaunch. Every stage emits one JSONL event (``topology_lost``,
+  ``remesh``) and the telemetry stream carries the schema-v5
+  ``topology_epoch`` / ``remesh_*`` field group.
+
+Real-pod coverage note: per-shard-local snapshots die with their host
+(an x-split state loses the lost host's columns), so a REAL host loss
+lands the disk rung by construction — the ring rung serves simulated
+topologies (all shards remain addressable) and any future
+host-redundant snapshot scheme. The 2-process drills are slow-marked
+(`tests/_multihost_worker.py`; the harness is environment-broken in
+this container, see ROADMAP).
 """
 
 from __future__ import annotations
@@ -84,6 +117,42 @@ from collections import deque
 from typing import NamedTuple, Optional
 
 import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# version-safe distributed-runtime probe (no backend touch, no private API)
+# ---------------------------------------------------------------------------
+
+# latch set by parallel.launch.init_distributed after a successful
+# bring-up — the fallback evidence on jax builds whose public
+# `jax.distributed.is_initialized` accessor does not exist yet (the
+# image's 0.4.x line). The former fallback read
+# `jax._src.distributed.global_state.client`, a private attribute that
+# moves between versions; this latch is version-proof and still never
+# touches the XLA backend (a backend probe would make a later
+# initialize() impossible). Library users on old jax who bypass
+# `launch.init_distributed` and call `jax.distributed.initialize`
+# directly should call :func:`note_distributed_initialized` too.
+_DIST_NOTED = False
+
+
+def note_distributed_initialized() -> None:
+    """Record that the jax distributed runtime is up (called by
+    ``parallel.launch.init_distributed``; see :func:`dist_initialized`)."""
+    global _DIST_NOTED
+    _DIST_NOTED = True
+
+
+def dist_initialized() -> bool:
+    """True when the jax distributed runtime is initialized — the
+    public ``jax.distributed.is_initialized`` accessor where the build
+    has it, else the ``init_distributed`` latch above. Never probes the
+    backend (safe to call before a later ``initialize()``)."""
+    import jax
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    return _DIST_NOTED
 
 
 # ---------------------------------------------------------------------------
@@ -111,20 +180,12 @@ class EventLog:
 
     @staticmethod
     def _is_writer() -> bool:
-        # same no-probe check as parallel.launch._dist_initialized
-        # (inlined: importing the parallel package here would drag the
-        # whole sharded stack into library users of EventLog): must
+        # version-safe no-probe check (dist_initialized above): must
         # not touch the XLA backend — EventLog exists before
         # init_distributed runs, and a backend probe would make a
         # later initialize() impossible
         import jax
-        probe = getattr(jax.distributed, "is_initialized", None)
-        if probe is not None:
-            inited = bool(probe())
-        else:
-            from jax._src import distributed as _dist
-            inited = _dist.global_state.client is not None
-        return (not inited) or jax.process_index() == 0
+        return (not dist_initialized()) or jax.process_index() == 0
 
     def emit(self, **fields) -> None:
         if not self._is_writer():
@@ -445,6 +506,12 @@ class StepGuard:
         self.lag = bool(lag)
         self.recoveries = 0       # completed recovery actions (telemetry)
         self.replayed_steps = 0   # cumulative replayed steps (telemetry)
+        # elastic-topology state (schema v5 field group; advanced only
+        # by elastic_recover — a run that never loses a host reports
+        # epoch 0 / count 0 forever)
+        self.topology_epoch = 0
+        self.remesh_count = 0
+        self.remesh_ms_total = 0.0
         self._pendings: list = []
         self._replay: list = []   # (dt, exact, trig) good steps since anchor
         self._since_snap = 0
@@ -681,19 +748,25 @@ class StepGuard:
             v = StepVerdict(False, "poisson_giveup(injected)")
         return v
 
-    # -- the recovery ladder ------------------------------------------
-    def _recover(self, pend: _Pending, vals: dict,
-                 v: StepVerdict) -> dict:
-        sim = self.sim
-        # any step dispatched on top of the bad one is garbage: drop it
-        # (and its optimistic snapshot) before rewinding — and REFUND
-        # the fault counts its dispatch consumed, so an injection armed
-        # for that step still fires at its real re-dispatch (the bad
-        # step's own fault genuinely fired and is not refunded)
+    def _discard_pendings(self) -> None:
+        """Drop every in-flight dispatch (and its optimistic snapshot)
+        and REFUND the fault counts each one consumed, so an injection
+        armed for a discarded step still fires at its real re-dispatch.
+        Shared by the ladder (garbage dispatched on top of a bad step)
+        and the elastic path (dispatches issued against a lost
+        topology) — one refund rule, one place."""
         for p in self._pendings:
             for ent in p.fired:
                 ent[1] += 1
         self._pendings.clear()
+
+    # -- the recovery ladder ------------------------------------------
+    def _recover(self, pend: _Pending, vals: dict,
+                 v: StepVerdict) -> dict:
+        sim = self.sim
+        # any step dispatched on top of the bad one is garbage (the bad
+        # step's own fault genuinely fired and is not refunded)
+        self._discard_pendings()
         step0 = pend.step0
         dt_used = self._dt_of(pend, vals)
         rung = 0
@@ -759,11 +832,18 @@ class StepGuard:
         steps bit-exactly (same dts, same exact-solve and trigger
         branches, faults suspended, no verdict pulls) up to the failed
         step."""
-        import contextlib
-
         from .io import restore_snapshot_device
+        restore_snapshot_device(self.sim, self.ring[-1])
+        return self._replay_recorded()
+
+    def _replay_recorded(self) -> int:
+        """Replay the recorded good steps since the anchor (the loop
+        half of :meth:`_rewind_replay`; the elastic path calls it after
+        its own re-sharding restore — there the replay runs on the NEW
+        mesh, so it reproduces the committed steps to the sharded-
+        equality bound rather than bit-exactly)."""
+        import contextlib
         sim = self.sim
-        restore_snapshot_device(sim, self.ring[-1])
         n = len(self._replay)
         if not n:
             return 0
@@ -858,6 +938,86 @@ class StepGuard:
         raise ResilienceAbort(
             f"step {step}: {v.reason}; recovery ladder exhausted"
             + (f" (post-mortem checkpoint: {pm})" if pm else ""))
+
+    # -- elastic topology recovery (PR 7) ------------------------------
+    def elastic_recover(self, topo: "TopologyGuard") -> None:
+        """Re-mesh the survivors and resume in place after ``topo``
+        declared a topology loss — no process relaunch.
+
+        Sequence (every stage one JSONL event):
+
+        1. every in-flight dispatch is garbage — it was issued against
+           the LOST topology (on a real pod its collectives would hang;
+           even verdicted-good pendings are dropped so the resume point
+           is a CONFIRMED anchor) — discard + refund its fault counts,
+           exactly like the ladder's discard;
+        2. survivors (deterministic on every process — same evidence,
+           same rule, see TopologyGuard) become a fresh 1-D mesh and
+           ``sim.remesh`` rebuilds placement/tables/step executables
+           over it (the SFC block partition is device-count-parametric,
+           so the forest re-partitions by construction);
+        3. state: the latest ring anchor where its shards still cover
+           the survivor set (``io.snapshot_covers`` — re-sharded onto
+           the new mesh by ``io.restore_snapshot_resharded``, then the
+           recorded steps since the anchor replayed on the new mesh),
+           else the last disk checkpoint, else abort through the
+           standard post-mortem machinery.
+
+        The ring is re-anchored on the new topology afterwards (old
+        entries carry lost-mesh placement and must never be restored).
+        """
+        import time as _time
+
+        sim = self.sim
+        t0 = _time.perf_counter()
+        # stage 1: discard + refund (the ladder's garbage-dispatch rule)
+        self._discard_pendings()
+        survivors = topo.survivor_devices()
+        anchor = self.ring[-1] if self.ring else None
+        from .io import load_checkpoint, restore_snapshot_resharded, \
+            snapshot_covers
+        use_ring = anchor is not None and snapshot_covers(
+            anchor, topo.lost_process_indices())
+        if not use_ring and not self._disk_available():
+            v = StepVerdict(False, "topology_lost")
+            self._abort(sim.step_count, v,
+                        {}, float("nan"))
+        if not survivors:
+            raise ResilienceAbort("topology loss left no survivor "
+                                  "devices — nothing to re-mesh onto")
+        # stage 2: re-mesh (lazy import: resilience must not drag the
+        # sharded stack into single-device library users)
+        from .parallel.mesh import make_mesh
+        mesh = make_mesh(devices=survivors)
+        sim.remesh(mesh)
+        # stage 3: resume
+        replayed = 0
+        if use_ring:
+            restore_snapshot_resharded(sim, anchor)
+            replayed = self._replay_recorded()
+            source = "ring"
+        else:
+            load_checkpoint(self.ckpt_dir, sim)
+            if self.watchdog is not None:
+                # the window describes steps forward of the restored
+                # point — stale as a baseline (same rule as the ladder's
+                # disk rung; the ring path resumes the SAME trajectory,
+                # so its window stays valid)
+                self.watchdog.reset()
+            source = "disk"
+        self.ring.clear()
+        self._reanchor()
+        self.topology_epoch = int(topo.epoch)
+        self.remesh_count += 1
+        ms = 1e3 * (_time.perf_counter() - t0)
+        self.remesh_ms_total += ms
+        self.recoveries += 1
+        if self.event_log is not None:
+            self.event_log.emit(
+                event="remesh", epoch=int(topo.epoch), source=source,
+                devices=len(survivors), step=int(sim.step_count),
+                sim_time=float(sim.time), replayed=replayed,
+                ms=round(ms, 3))
 
 
 # ---------------------------------------------------------------------------
@@ -988,10 +1148,7 @@ class FleetStepGuard(StepGuard):
                          verdicts: list, bad: list) -> dict:
         sim = self.sim
         # discard (and refund) any dispatch stacked on the bad step
-        for p in self._pendings:
-            for ent in p.fired:
-                ent[1] += 1
-        self._pendings.clear()
+        self._discard_pendings()
         # the optimistic post-step snapshot contains the bad slices —
         # it must never become an anchor
         pend.snap = None
@@ -1179,15 +1336,15 @@ class PreemptionGuard:
         process — it is a collective on pods. Single-host (or before
         distributed init): just the local flag, no device/collective
         cost. Drilled with skewed sigterm@N delivery by the multihost
-        harness (tests/_multihost_worker.py)."""
+        harness (tests/_multihost_worker.py).
+
+        Pre-init / single-process FAST PATH: before the distributed
+        runtime is up (or when it was never brought up) this is just
+        the local flag — no collective, no device touch, no backend
+        probe (the version-safe :func:`dist_initialized` check). Unit-
+        tested in tests/test_elastic.py."""
         import jax
-        probe = getattr(jax.distributed, "is_initialized", None)
-        if probe is not None:
-            inited = bool(probe())
-        else:
-            from jax._src import distributed as _dist
-            inited = _dist.global_state.client is not None
-        if not inited or jax.process_count() == 1:
+        if not dist_initialized() or jax.process_count() == 1:
             return self.triggered
         from jax.experimental import multihost_utils
         flags = multihost_utils.process_allgather(
@@ -1199,3 +1356,235 @@ class PreemptionGuard:
         for s, h in self._prev.items():
             signal.signal(s, h)
         self._prev.clear()
+
+
+# ---------------------------------------------------------------------------
+# elastic topology detection + agreement (PR 7)
+# ---------------------------------------------------------------------------
+
+def bounded_call(fn, timeout: float):
+    """Run ``fn()`` with a deadline: returns ``(True, result)`` when it
+    completes within ``timeout`` seconds, ``(False, None)`` when it is
+    still blocked at the deadline — the hang watchdog for collectives
+    (a peer that died mid-step leaves the survivors' next allgather
+    blocked forever; this turns the infinite hang into evidence). The
+    worker thread is a daemon: a genuinely hung collective cannot be
+    cancelled, only observed — its thread is abandoned with the dying
+    world. An exception inside ``fn`` is re-raised here."""
+    import threading
+    box: dict = {}
+
+    def _run():
+        try:
+            box["result"] = fn()
+        except BaseException as e:   # surfaced to the caller below
+            box["error"] = e
+
+    th = threading.Thread(target=_run, daemon=True)
+    th.start()
+    th.join(timeout)
+    if th.is_alive():
+        return False, None
+    if "error" in box:
+        raise box["error"]
+    return True, box.get("result")
+
+
+class Beat(NamedTuple):
+    """One step-boundary heartbeat result (TopologyGuard.step_boundary)."""
+
+    stop: bool          # SIGTERM agreement (PreemptionGuard semantics)
+    lost: tuple         # hosts DECLARED lost at this beat (may be empty)
+    self_lost: bool     # real mode: THIS process was told to die
+    hung: bool          # the bounded collective missed its deadline
+
+
+class TopologyGuard:
+    """Detection + agreement half of the elastic recovery subsystem.
+
+    Two modes share one protocol:
+
+    - **Simulated** (``sim_hosts=H``, single process): the device list
+      is grouped into H contiguous "hosts" (the same contiguous-range
+      layout a real pod has — parallel/launch.global_mesh). Losses are
+      injected by ``faults.py`` ``host_exit@N`` / ``host_hang@N``
+      directives: the directive marks the highest-index alive host
+      dead at step N's boundary, and each subsequent :meth:`poll` is
+      one missed beat — after ``miss_k`` consecutive misses the host
+      is DECLARED lost and the epoch bumps. This is the tier-1 drill
+      mode: the virtual devices all remain addressable, so the
+      snapshot-ring resume path runs end-to-end in one process.
+    - **Real** (multi-process): the heartbeat piggybacks on the
+      step-boundary collective :meth:`PreemptionGuard.agree` already
+      pays — ONE allgather of ``[sigterm, epoch, exiting]`` int32s per
+      process, run under ``timeout`` via :func:`bounded_call`. A
+      graceful loss (``host_exit@N`` on that process) announces itself
+      in its final beat (``exiting=1``), so every survivor sees the
+      same evidence vector and computes the same survivor set + epoch
+      — agreement by construction, no extra round. A hard loss
+      (``host_hang@N``, a kill) surfaces as the next beat's deadline
+      miss: the world's collectives are unusable from that instant, so
+      in-place recovery additionally needs a runtime re-init
+      (``parallel.launch.reinit_distributed``) before any further
+      collective — the slow-marked 2-process drill's path.
+
+    The DECISION rule is deterministic on identical evidence: survivors
+    = alive hosts in original order, epoch += 1 per declaration batch.
+    Every declaration emits one ``topology_lost`` JSONL event.
+    """
+
+    def __init__(self, devices=None, *, sim_hosts: Optional[int] = None,
+                 miss_k: int = 3, timeout: float = 10.0,
+                 faults=None, event_log=None):
+        import jax
+        self.devices = list(devices) if devices is not None \
+            else list(jax.devices())
+        self.miss_k = max(1, int(miss_k))
+        self.timeout = float(timeout)
+        self.faults = faults
+        self.event_log = event_log
+        self.epoch = 0
+        self.hung = False
+        self._exiting = False
+        self._lost_processes: set = set()
+        if sim_hosts is not None:
+            h = int(sim_hosts)
+            if h < 2 or len(self.devices) % h:
+                raise ValueError(
+                    f"sim_hosts={h}: need >= 2 simulated hosts (losing "
+                    "the only host leaves nothing to re-mesh onto) "
+                    f"dividing the {len(self.devices)}-device set into "
+                    "equal contiguous groups")
+            self.sim_hosts = h
+        else:
+            self.sim_hosts = None
+        n = self.n_hosts
+        self.alive = [True] * n
+        self._dead: dict = {}      # host -> fault kind (not yet declared)
+        self._missed: dict = {}    # host -> consecutive missed beats
+
+    # -- topology bookkeeping -----------------------------------------
+    @property
+    def n_hosts(self) -> int:
+        if self.sim_hosts is not None:
+            return self.sim_hosts
+        import jax
+        return jax.process_count() if dist_initialized() else 1
+
+    def _host_of(self, idx: int) -> int:
+        """Host owning device index ``idx`` (contiguous groups)."""
+        if self.sim_hosts is not None:
+            return idx * self.sim_hosts // len(self.devices)
+        return int(getattr(self.devices[idx], "process_index", 0))
+
+    def survivor_devices(self) -> list:
+        """Devices of the alive hosts, in original (SFC-contiguous)
+        order — identical on every survivor by the determinism rule."""
+        return [d for i, d in enumerate(self.devices)
+                if self.alive[self._host_of(i)]]
+
+    def lost_process_indices(self) -> tuple:
+        """Process indices declared lost (REAL mode; empty for
+        simulated hosts — the single process survives them all), for
+        ``io.snapshot_covers``."""
+        return tuple(sorted(self._lost_processes))
+
+    # -- detection -----------------------------------------------------
+    def poll(self, step: int) -> tuple:
+        """One simulated-mode heartbeat at the boundary of ``step``:
+        consume any host-loss fault armed for this step, count one
+        missed beat per dead-but-undeclared host, and DECLARE the ones
+        that reached ``miss_k`` misses. Returns the hosts declared at
+        THIS beat (empty tuple almost always)."""
+        if self.faults is not None:
+            for kind in self.faults.host_loss_at(step):
+                h = self._highest_alive_undead()
+                if h is not None:
+                    self._dead[h] = kind
+        newly = []
+        for h, kind in self._dead.items():
+            if not self.alive[h]:
+                continue
+            self._missed[h] = self._missed.get(h, 0) + 1
+            if self._missed[h] >= self.miss_k:
+                newly.append(h)
+        if newly:
+            self._declare(newly, step)
+        return tuple(newly)
+
+    def _highest_alive_undead(self):
+        for h in range(self.n_hosts - 1, -1, -1):
+            if self.alive[h] and h not in self._dead:
+                return h
+        return None
+
+    def _declare(self, hosts, step) -> None:
+        for h in hosts:
+            self.alive[h] = False
+            if self.sim_hosts is None:
+                self._lost_processes.add(h)
+        self.epoch += 1
+        if self.event_log is not None:
+            self.event_log.emit(
+                event="topology_lost", epoch=self.epoch,
+                hosts=[int(h) for h in hosts],
+                kinds=[str(self._dead.get(h, "?")) for h in hosts],
+                step=int(step), miss_k=self.miss_k,
+                survivors=len(self.survivor_devices()))
+
+    # -- the piggybacked step-boundary collective ---------------------
+    def step_boundary(self, stop: PreemptionGuard, step: int) -> Beat:
+        """The combined step-boundary call: SIGTERM agreement AND
+        heartbeat in the ONE small collective the loop already paid for
+        ``PreemptionGuard.agree`` (real mode), or the local fast path +
+        simulated poll (single process)."""
+        import jax
+        if self.sim_hosts is not None or not dist_initialized() \
+                or jax.process_count() == 1:
+            return Beat(stop=stop.agree(), lost=self.poll(step),
+                        self_lost=False, hung=False)
+        # real mode: host-loss directives are PROCESS-scoped here (the
+        # same env-latched plan, a different consumer than the
+        # simulated poll — sigterm@N precedent)
+        self_kind = None
+        if self.faults is not None:
+            kinds = self.faults.host_loss_at(step)
+            if kinds:
+                self_kind = kinds[-1]
+                if self_kind == "exit":
+                    # announce in this (final) beat so the survivors'
+                    # evidence is complete BEFORE the process dies
+                    self._exiting = True
+        from jax.experimental import multihost_utils
+        payload = np.asarray(
+            [1 if stop.triggered else 0, self.epoch,
+             1 if self._exiting else 0], np.int32)
+        done, flags = bounded_call(
+            lambda: multihost_utils.process_allgather(payload),
+            self.timeout)
+        if not done:
+            # the collective itself blocked past its deadline: a peer
+            # died mid-step. The old world's collectives are unusable;
+            # the caller must re-init the runtime before re-meshing.
+            self.hung = True
+            if self.event_log is not None:
+                self.event_log.emit(event="topology_hang", step=int(step),
+                                    timeout_s=self.timeout,
+                                    epoch=self.epoch)
+            return Beat(stop=False, lost=(), self_lost=False, hung=True)
+        flags = np.asarray(flags).reshape(-1, 3)
+        exiting = [p for p in range(flags.shape[0])
+                   if flags[p, 2] and self.alive[p]
+                   and p != jax.process_index()]
+        if exiting:
+            for h in exiting:
+                self._dead[h] = "exit"
+            self._declare(exiting, step)
+        alive_rows = [p for p in range(flags.shape[0]) if self.alive[p]]
+        stop_agreed = bool(np.min(flags[alive_rows, 0]) > 0)
+        if self_kind == "hang":
+            # simulate the hard-loss flavor: stop beating, keep the
+            # process (the survivors' NEXT beat hits the deadline)
+            time.sleep(1e9)
+        return Beat(stop=stop_agreed, lost=tuple(exiting),
+                    self_lost=(self_kind == "exit"), hung=False)

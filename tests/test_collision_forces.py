@@ -5,6 +5,7 @@ import io
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from cup2d_tpu.config import SimConfig
 from cup2d_tpu.models import DiskShape
@@ -94,6 +95,14 @@ def test_towed_disk_forces_and_log():
     assert header.startswith("time,shape,perimeter")
 
 
+@pytest.mark.slow   # ~18 s; duplicative tier-1 coverage: the same
+#                     towed-into-free collision impulse is pinned
+#                     BIT-LEVEL by test_golden_collision.py's golden
+#                     trajectory (which fails on any physics change
+#                     this behavioral assert would catch), and the
+#                     multi-disk stepping path stays tier-1 via
+#                     test_many_disk_simulation_steps — slow-marked to
+#                     fund the PR-7 elastic drill within the 870 s cap
 def test_overlapping_disks_collide_in_sim():
     """Towed disk driven into a free disk: the collision impulse must set
     the free disk moving away (positive u)."""

@@ -107,6 +107,15 @@ def test_per_step_comm_is_boundary_proportional():
     _assert_boundary_proportional(txt, sim, cfg, "tags")
 
 
+@pytest.mark.slow   # ~26 s; duplicative tier-1 coverage: the comm
+#                     VOLUME bound (the regression class that actually
+#                     moves) stays tier-1 via
+#                     test_per_step_comm_is_boundary_proportional, and
+#                     the local/remote row-split STRUCTURE this asserts
+#                     is fixed at table-build time (halo.py round 4) and
+#                     re-evidenced by the standing
+#                     validation/overlap_check.py probe — slow-marked to
+#                     fund the PR-7 elastic drill within the 870 s cap
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
 def test_exchange_has_overlappable_local_work():
     """Comm/compute overlap as STRUCTURE (VERDICT r3 #6): in the

@@ -19,8 +19,7 @@ PKG = os.path.normpath(
 
 # files where ANY CUP2D_* read is a sanctioned latch:
 #   config.py — the typed-config construction point
-#   faults.py — FaultPlan.from_env, the fault-injection latch
-SANCTIONED_FILES = {"config.py", "faults.py"}
+SANCTIONED_FILES = {"config.py"}
 
 # (file, enclosing scope) -> allowed vars. Each is a construct-once /
 # enable-once latch, grandfathered with its reason:
@@ -36,6 +35,13 @@ SANCTIONED_SITES = {
     # self.solver_mode+self.fas_fmg)
     ("uniform.py", "UniformGrid.__init__"): {"CUP2D_PALLAS",
                                              "CUP2D_POIS"},
+    # the fault-injection latch (PR 7 tightened faults.py from a
+    # whole-file sanction to this one scope): every injector —
+    # including the elastic host_exit/host_hang tokens — parses from
+    # the ONE plan FaultPlan.from_env constructs; consumers (StepGuard,
+    # TopologyGuard, io's crash window) read the plan object, never the
+    # env
+    ("faults.py", "FaultPlan.from_env"): {"CUP2D_FAULTS"},
     # read once from ShardedAMRSim.__init__, stored as self._exchange
     ("parallel/forest_mesh.py", "_exchange_mode"):
         {"CUP2D_SHARD_EXCHANGE"},

@@ -25,33 +25,55 @@ def _free_port() -> int:
     return port
 
 
-@pytest.mark.slow
-def test_two_process_amr_determinism(tmp_path):
+def _run_workers(outdir, extra_args=(), extra_env=None, per_pid_env=None,
+                 allow_rc=None):
+    """Spawn the 2-process worker pair; returns their stdouts. SKIPs
+    the calling test when the worker's capability probe reports the
+    broken multiprocess CPU backend (SKIP_MULTIPROCESS — the
+    documented, pre-existing container regression, ROADMAP) instead of
+    erroring."""
     port = _free_port()
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     worker = os.path.join(root, "tests", "_multihost_worker.py")
-    outdir = str(tmp_path)     # pytest-managed: auto-cleaned
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)           # worker sets its own count
     env["PYTHONPATH"] = root
     env["CUP2D_MH_OUTDIR"] = outdir
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(pid), str(port)],
+    if extra_env:
+        env.update(extra_env)
+    procs = []
+    for pid in (0, 1):
+        penv = dict(env)
+        if per_pid_env and pid in per_pid_env:
+            penv.update(per_pid_env[pid])
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(pid), str(port),
+             *map(str, extra_args)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True, env=env, cwd=root)
-        for pid in (0, 1)
-    ]
+            text=True, env=penv, cwd=root))
     outs = []
-    for p in procs:
+    for pid, p in enumerate(procs):
         try:
             out, err = p.communicate(timeout=900)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             raise
-        assert p.returncode == 0, f"worker failed:\n{err[-4000:]}"
+        ok_rcs = {0} | set(allow_rc.get(pid, ()) if allow_rc else ())
+        assert p.returncode in ok_rcs, f"worker failed:\n{err[-4000:]}"
         outs.append(out)
+    if any("SKIP_MULTIPROCESS" in out for out in outs):
+        line = next(ln for out in outs for ln in out.splitlines()
+                    if ln.startswith("SKIP_MULTIPROCESS"))
+        pytest.skip(
+            "CPU backend rejects multiprocess computations on this box "
+            f"(pre-existing, ROADMAP): {line}")
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_amr_determinism(tmp_path):
+    outs = _run_workers(str(tmp_path))
     digests = []
     iohashes = []
     buckets = []
@@ -83,3 +105,24 @@ def test_two_process_amr_determinism(tmp_path):
     # processes stop at the SAME step boundary — the later latch —
     # and enter the collective checkpoint together
     assert sigterms[0] == sigterms[1] == ["SIGTERM_AGREE 5"], sigterms
+
+
+@pytest.mark.slow   # 2-process runtime drill — environment-broken in
+#                     this container (the capability probe SKIPs);
+#                     validates on the first box with a working
+#                     multiprocess jax.distributed CPU runtime (ROADMAP)
+def test_two_process_elastic_host_loss(tmp_path):
+    """Real-mode elastic drill: process 1 host_exits mid-run (announced
+    in its final heartbeat, then a hard os._exit(17)); process 0's same
+    beat sees the announcement, declares the loss, re-inits the runtime
+    as a 1-process world on a fresh port, re-meshes onto its surviving
+    devices and resumes from the disk checkpoint (per-shard snapshots
+    died with the host — the designed real-loss rung)."""
+    reinit_port = _free_port()
+    outs = _run_workers(
+        str(tmp_path), extra_args=(reinit_port,),
+        extra_env={"CUP2D_MH_PHASE": "elastic"},
+        per_pid_env={1: {"CUP2D_FAULTS": "host_exit@23"}},
+        allow_rc={1: (17,)})             # pid 1 dies by design
+    assert any(ln.startswith("ELASTIC_RESUMED")
+               for ln in outs[0].splitlines()), outs[0]

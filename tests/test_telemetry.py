@@ -55,14 +55,16 @@ def _amr_sim():
 # schema stability (golden key set): every producer emits the SAME keys
 # ---------------------------------------------------------------------------
 
-# the LITERAL schema-v4 key set: METRICS_KEYS is the producers' truth,
+# the LITERAL schema-v5 key set: METRICS_KEYS is the producers' truth,
 # this tuple is the consumers' — any drift between them (a key renamed,
 # dropped, or added without bumping the schema) fails here on purpose.
 # v3 added the fleet-batching fields (fleet_members / member_steps_per_s
 # / member_health, fleet.py); v4 the solve-path attribution pair
 # (poisson_mode — the active CUP2D_POIS latch + trigger state — and the
-# per-step preconditioner-cycle count, PR 6).
-_SCHEMA_V4_KEYS = (
+# per-step preconditioner-cycle count, PR 6); v5 the elastic-topology
+# group (topology_epoch / remesh_count / remesh_ms — the TopologyGuard
+# + StepGuard.elastic_recover subsystem, PR 7).
+_SCHEMA_V5_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     "umax", "dt_next",
     "poisson_iters", "poisson_residual",
@@ -73,15 +75,16 @@ _SCHEMA_V4_KEYS = (
     "halo_real_bytes", "halo_padded_bytes",
     "jit_compiles", "device_gets", "state_gathers", "hbm_peak_bytes",
     "snap_ring_bytes", "replayed_steps",
+    "topology_epoch", "remesh_count", "remesh_ms",
     "fleet_members", "member_steps_per_s", "member_health",
     "phase_ms",
 )
 
 
-def test_metrics_schema_v4_key_set_pinned():
+def test_metrics_schema_v5_key_set_pinned():
     from cup2d_tpu.profiling import METRICS_SCHEMA_VERSION
-    assert METRICS_SCHEMA_VERSION == 4
-    assert METRICS_KEYS == _SCHEMA_V4_KEYS
+    assert METRICS_SCHEMA_VERSION == 5
+    assert METRICS_KEYS == _SCHEMA_V5_KEYS
 
 
 def test_metrics_schema_stable_uniform_amr_bench():
