@@ -51,6 +51,11 @@ FLOPS_ITER_PER_CELL = 290.0
 # projection: ~22 f32 field sweeps; Krylov iteration touches ~12 arrays.
 BYTES_STEP_PER_CELL = 22 * 4.0
 BYTES_ITER_PER_CELL = 12 * 4.0
+# one Heun SUBSTAGE (the kernel_curve unit, PR 9): half the advection
+# work above (~440/cell: WENO5 x2 directions x2 components ~440) plus
+# the 3-flop state update — documented estimate, shared by every tier
+# so the MFU column is comparable across them.
+FLOPS_SUBSTAGE_PER_CELL = 443.0
 
 
 def bench_state(grid):
@@ -542,6 +547,117 @@ def run_poisson_curve(size: int, tol_rel: float = 1e-3,
                      "fence methodology of run_size")}
 
 
+def run_kernel_curve(size: int, n_rep: int = 3):
+    """Advection kernel-tier micro-curve (PR 9): ms per Heun SUBSTAGE
+    for the XLA op chain vs the fused Pallas megakernel (f32 and bf16
+    storage), with the MODELED HBM bytes per substage and the derived
+    HBM-util% / MFU% against the v5e peaks — so acceptance is roofline
+    movement against the r04 anchors (0.95% MFU / 12% HBM util), not
+    just wall-clock. Timing covers one full Heun (both substages)
+    divided by 2, apples-to-apples across tiers.
+
+    Bytes model (per substage, field = 2 * N^2 * itemsize; the modeled
+    pass counts are the asserted ISSUE-9 acceptance — XLA's chain
+    re-reads the field >= 3x where the megakernel reads it once):
+      xla   : 3 field reads (vel by pad; lab + vold by the fused
+              RHS+update kernel) + 2 writes (lab, vel) = 5 f32 passes
+      fused : stage 1 reads vel ONCE, writes once (2 passes); stage 2
+              adds the vold read (3 passes) -> 2.5 f32 passes/substage
+      bf16  : same passes at bf16 width, plus the once-per-step f32
+              state <-> bf16 cast (1 f32 read + 1 bf16 write) and the
+              stage-2 f32 final write -> 2 f32 + 5 bf16 passes per
+              STEP = 2.25 f32-equivalent passes/substage. Halo bytes
+              (<0.1% at bench sizes) ignored.
+
+    On non-TPU hosts the fused tiers run in Pallas interpret mode: the
+    ms/util columns are then NOT kernel performance (interpret_mode
+    says so) but the bytes model and tier plumbing are
+    platform-independent, so the smoke can pin the schema."""
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.ops.pallas_kernels import (_on_accel,
+                                              fused_advect_heun,
+                                              fused_tier_supported)
+    from cup2d_tpu.ops.stencil import advect_diffuse_rhs, heun_substage
+    from cup2d_tpu.uniform import UniformGrid, pad_vector
+
+    level = int(np.log2(size // 8))
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=1, level_start=0,
+                    extent=1.0, nu=4e-5, cfl=0.5, dtype="float32")
+    grid = UniformGrid(cfg, level=level)
+    vel0 = bench_state(grid).vel
+    h, nu = grid.h, cfg.nu
+    ih2 = 1.0 / (h * h)
+    dt = jnp.asarray(0.5 * h, jnp.float32)
+
+    def xla_heun(v):
+        vold = v
+        for c in (0.5, 1.0):
+            lab = pad_vector(v, 3)
+            rhs = advect_diffuse_rhs(lab, 3, h, nu, dt)
+            v = heun_substage(vold, c, rhs, ih2)
+        return v
+
+    def measure(fn):
+        f = jax.jit(fn)
+        out = f(vel0)
+        _fence(out)                       # compile + warm
+        lat = _latency_floor(dt)
+        t0 = time.perf_counter()
+        for _ in range(n_rep):
+            out = f(out)
+        _fence(out)
+        wall = max(time.perf_counter() - t0 - lat, 1e-9)
+        return wall / n_rep / 2.0 * 1e3   # ms per SUBSTAGE
+
+    fb4 = 2.0 * size * size * 4.0         # one f32 velocity field
+    cells = float(size * size)
+
+    def derived(ms, passes_f32_equiv):
+        hbm = passes_f32_equiv * fb4
+        sec = ms * 1e-3
+        return {
+            "hbm_bytes": hbm,
+            "hbm_util_pct": round(
+                hbm / sec / (PEAK_HBM_GBPS * 1e9) * 100.0, 3),
+            "mfu_pct": round(
+                FLOPS_SUBSTAGE_PER_CELL * cells / sec
+                / (PEAK_F32_TFLOPS * 1e12) * 100.0, 3),
+        }
+
+    tiers = {}
+    ms = measure(xla_heun)
+    tiers["xla"] = {
+        "ms_per_substage": round(ms, 4),
+        "adv_field_reads": 3, "adv_field_writes": 2,
+        "storage_dtype": "f32", **derived(ms, 5.0)}
+    if fused_tier_supported(grid.ny, grid.nx, prec="f32"):
+        ms = measure(lambda v: fused_advect_heun(v, h, nu, dt))
+        tiers["pallas_fused"] = {
+            "ms_per_substage": round(ms, 4),
+            "adv_field_reads": 1, "adv_field_writes": 1,
+            "storage_dtype": "f32", **derived(ms, 2.5)}
+    if fused_tier_supported(grid.ny, grid.nx, prec="bf16"):
+        ms = measure(lambda v: fused_advect_heun(v, h, nu, dt,
+                                                 bf16=True))
+        tiers["pallas_fused_bf16"] = {
+            "ms_per_substage": round(ms, 4),
+            "adv_field_reads": 1, "adv_field_writes": 1,
+            "storage_dtype": "bf16", **derived(ms, 2.25)}
+    return {
+        "grid": f"{size}x{size}",
+        "interpret_mode": not _on_accel(),
+        "flops_substage_per_cell": FLOPS_SUBSTAGE_PER_CELL,
+        "tiers": tiers,
+        "anchors_r04": {"mfu_pct": 0.95, "hbm_util_pct": 12.0},
+        "note": ("ms = one full Heun (jit, fenced, latency floor "
+                 "subtracted) / 2 substages; reads/writes are MODELED "
+                 "full-field HBM passes per substage (see "
+                 "run_kernel_curve docstring for the bytes model); "
+                 "util percentages use the v5e peak constants and are "
+                 "meaningless in interpret_mode"),
+    }
+
+
 def _init_platform() -> str:
     """Initialize an available backend. On boxes without the configured
     accelerator, jax's first device probe dies with RuntimeError
@@ -630,6 +746,17 @@ def main():
                 int(os.environ.get("BENCH_POISSON_SIZE", "1024")))
         except Exception as e:           # noqa: BLE001 - bench must print
             poisson = {"error": f"{type(e).__name__}: {e}"}
+    # advection kernel-tier micro-curve (BENCH_KERNEL=0 skips;
+    # BENCH_KERNEL_SIZE defaults to the primary size so the rig
+    # re-measure against the r04 roofline anchors is one command)
+    kernel = None
+    if os.environ.get("BENCH_KERNEL", "1") != "0":
+        try:
+            kernel = run_kernel_curve(
+                int(os.environ.get("BENCH_KERNEL_SIZE", str(size))),
+                n_rep=int(os.environ.get("BENCH_KERNEL_REPS", "3")))
+        except Exception as e:           # noqa: BLE001 - bench must print
+            kernel = {"error": f"{type(e).__name__}: {e}"}
 
     # PRIMARY metric: DEVICE-derived throughput (profiler module time
     # over chained steps). The fenced-wall number carries host/tunnel
@@ -697,6 +824,8 @@ def main():
         out["fleet"] = fleet
     if poisson:
         out["poisson_curve"] = poisson
+    if kernel:
+        out["kernel_curve"] = kernel
     if secondary:
         out["secondary"] = secondary
     print(json.dumps(out))

@@ -63,7 +63,7 @@ from .ops.obstacle import (
     solve_rigid_momentum,
 )
 from .ops.stencil import advect_diffuse_rhs, divergence, dt_from_umax, \
-    laplacian5, pressure_gradient_update, vorticity
+    heun_substage, laplacian5, pressure_gradient_update, vorticity
 from .poisson import apply_block_precond_blocks, bicgstab, \
     block_precond_matrix, coarse_neumann_solve_dct
 from .profiling import NULL_TIMERS
@@ -170,6 +170,18 @@ class AMRSim(ShapeHostMixin):
             raise ValueError(
                 f"CUP2D_TWOLEVEL={self._twolevel_form!r}: "
                 "expected additive|mult|mg2")
+        # fused advection-kernel tier latch (PR 9; same construct-once
+        # discipline). The forest's fusable unit is lab -> RHS (flux
+        # corrections interleave before the Heun update), served by the
+        # block-batched ops/pallas_kernels.fused_lab_rhs. f32 only —
+        # Mosaic has no f64, and the bf16 storage tier is a
+        # uniform/fleet contract (CUP2D_PREC is latched by UniformGrid,
+        # the one sanctioned read site).
+        self._kernel_tier = "xla"
+        if os.environ.get("CUP2D_PALLAS", "") == "1":
+            from .ops.pallas_kernels import lab_tier_supported
+            if lab_tier_supported(cfg.dtype):
+                self._kernel_tier = "pallas-fused"
         if shapes is None:
             from .sim import make_shapes
             shapes = make_shapes(cfg)
@@ -707,10 +719,17 @@ class AMRSim(ShapeHostMixin):
         v = vold
         for c in (0.5, 1.0):
             lab = assemble_labs_ordered(v if c == 1.0 else vel, t3)
-            rhs = advect_diffuse_rhs(lab, 3, h, cfg.nu, dt)
+            if self._kernel_tier != "xla":
+                # forest-block-batched fused RHS: one HBM read of the
+                # lab batch per stage, per-block h rides the kernel's
+                # (afac, dfac) scale rows
+                from .ops.pallas_kernels import fused_lab_rhs
+                rhs = fused_lab_rhs(lab, h, cfg.nu, dt)
+            else:
+                rhs = advect_diffuse_rhs(lab, 3, h, cfg.nu, dt)
             rhs = apply_flux_corr(
                 rhs, diffusive_deposits(lab, 3, cfg.nu * dt), corr)
-            v = (vold + c * rhs * ih2) * maskv
+            v = heun_substage(vold, c, rhs, ih2) * maskv
         return v
 
     def _pressure_project(self, v, pres, dt, h, hsq,
@@ -996,6 +1015,19 @@ class AMRSim(ShapeHostMixin):
             return "bicgstab+fft"
         return ("bicgstab+twolevel" if self._coarse_on
                 else "bicgstab+jacobi")
+
+    @property
+    def kernel_tier(self) -> str:
+        """Active advection-kernel tier (telemetry schema v6)."""
+        return self._kernel_tier
+
+    @property
+    def prec_mode(self) -> str:
+        """Hot-loop storage precision (telemetry schema v6). The forest
+        has no bf16 storage tier (CUP2D_PREC is a uniform/fleet
+        contract), so this is always the field dtype."""
+        return {"float32": "f32", "float64": "f64"}.get(
+            self.forest.dtype.name, self.forest.dtype.name)
 
     def _energy(self, v, hsq):
         """Kinetic energy of the masked ordered velocity — the

@@ -72,14 +72,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import SimConfig
+from .ops.pallas_kernels import fused_advect_heun
 from .ops.stencil import (
     advect_diffuse_rhs,
     divergence_freeslip,
     dt_from_umax,
+    heun_substage,
     laplacian5_neumann,
-    pressure_gradient_update_fused,
 )
-from .poisson import bicgstab, mg_solve
+from .poisson import bicgstab, mg_solve, project_correct
 from .uniform import FlowState, UniformGrid, pad_vector, taylor_green_state
 
 
@@ -207,6 +208,19 @@ class FleetSim:
         (tests/test_env_latch.py walks it)."""
         return self.grid.poisson_mode
 
+    @property
+    def kernel_tier(self) -> str:
+        """Active advection-kernel tier (telemetry schema v6) — the
+        grid's constructor latch; under spatial placement the grid
+        refuses the fused tier at construction (spmd_safe), so a
+        FleetSim that exists is always tier-consistent."""
+        return self.grid.kernel_tier
+
+    @property
+    def prec_mode(self) -> str:
+        """Hot-loop storage precision (telemetry schema v6)."""
+        return self.grid.prec_mode
+
     def _pressure_solve(self, rhs: jnp.ndarray, exact: bool):
         """Member-batched ``UniformGrid.pressure_solve``: same
         tolerances/refresh/stall policy and the same CUP2D_POIS solve
@@ -255,22 +269,29 @@ class FleetSim:
 
         # -- advection-diffusion, 2-stage Heun (per-member dt) --
         vel = state.vel
-        vold = vel
-        for c in (0.5, 1.0):
-            lab = pad_vector(vel, 3)
-            rhs = advect_diffuse_rhs(lab, 3, h, g.cfg.nu, dt4)
-            vel = vold + c * rhs * ih2
+        if g.kernel_tier != "xla":
+            # fused megakernel tier, member-batched: the kernel is
+            # leading-dim agnostic with a per-member (afac, dfac) row,
+            # so B members share ONE dispatch per substage
+            vel = fused_advect_heun(
+                vel, h, g.cfg.nu, dt,
+                bf16=g.kernel_tier == "pallas-fused-bf16")
+        else:
+            vold = vel
+            for c in (0.5, 1.0):
+                lab = pad_vector(vel, 3)
+                rhs = advect_diffuse_rhs(lab, 3, h, g.cfg.nu, dt4)
+                vel = heun_substage(vold, c, rhs, ih2)
 
         # -- deltap pressure projection (chi == 0) --
         b = (0.5 * h / dt3) * divergence_freeslip(vel, g.spmd_safe)
         div_linf = jnp.max(jnp.abs(b), axis=(-2, -1)) * (dt / (h * h))
         b = b - laplacian5_neumann(state.pres, g.spmd_safe)
         res = self._pressure_solve(b, exact_poisson)
-        dp = res.x - jnp.mean(res.x, axis=(-2, -1), keepdims=True)
-        pres = dp + state.pres - jnp.mean(state.pres, axis=(-2, -1),
-                                          keepdims=True)
-        dv = pressure_gradient_update_fused(pres, h, dt4, g.spmd_safe)
-        vel = vel + dv * ih2
+        vel, pres = project_correct(
+            res.x, state.pres, vel, h, dt,
+            spmd_safe=g.spmd_safe, mean_axes=(-2, -1),
+            tier=g.kernel_tier)
 
         # -- per-member diag (the one batched pull's payload) --
         umax = jnp.max(jnp.abs(vel), axis=(-3, -2, -1))

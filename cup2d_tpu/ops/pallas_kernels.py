@@ -1,22 +1,53 @@
-"""Pallas TPU kernel for the WENO5 advection-diffusion RHS.
+"""Pallas TPU kernels for the advection hot loop.
 
-Architecture: each grid step owns a row strip; x-chunks are
-double-buffered HBM->VMEM (copy latency hides behind the previous
-chunk's arithmetic), the whole 60-op WENO chain runs in VMEM, and the
-RHS is written once. DMA slices must be tile-aligned, hence the y halo
-padded 3 -> 4 (sublane 8) and the x halo 3 -> 64 (lane 128); the
-alignment-only ghosts are never read. The kernel is bit-identical to
-the XLA path (same jnp ops traced by Mosaic; tests compare exactly).
+Two generations live here:
 
-MEASURED VERDICT (v5e, f32, 8192^2): 38 ms vs XLA-fused 30 ms per
-evaluation — both ~20x above the HBM roofline (~1.3 ms), i.e. the op is
-bound by VPU divides (6 per WENO reconstruction) and lane-shift
-permutes, not by the fusion/HBM traffic a Pallas rewrite eliminates.
-Kept as OPT-IN (CUP2D_PALLAS=1 env, or UniformGrid(use_pallas=True)):
-correct, tested, and the scaffolding for kernels where manual tiling
-does win (bf16 variants, fused multi-stage updates), but NOT the
-default — shipping a slower default to claim "has Pallas" would be
-exactly the aspirational-README failure mode VERDICT r1 flagged.
+1. The round-4 single-op kernel (``advect_diffuse_rhs_pallas``): one
+   WENO5 advect-diffuse RHS evaluation over a pre-padded lab, y-strip
+   grid with double-buffered x-chunk DMA. MEASURED VERDICT (v5e, f32,
+   8192^2): 38 ms vs XLA-fused 30 ms per evaluation — both ~20x above
+   the HBM roofline (~1.3 ms), i.e. the single op is bound by VPU
+   divides and lane-shift permutes, not by the HBM traffic a Pallas
+   rewrite of ONE op can eliminate. Kept as the measured-history
+   baseline and for the TPU bit-parity test.
+
+2. The PR-9 **megakernel tier** (``fused_advect_heun`` /
+   ``fused_lab_rhs`` / ``fused_correction``): one kernel per RK
+   substage that reads the velocity from HBM ONCE, synthesizes the
+   free-slip ghost halo in VMEM, runs the whole WENO5 + diffusion +
+   Heun-update chain on double-buffered row strips, and writes the
+   substage result once — attacking the per-op dispatch chain whose
+   re-reads pinned BENCH_r04 at 12% HBM utilization. The divide-free
+   WENO weight normalization (single reciprocal of the summed alpha
+   per component, bit-trick reciprocal for the scale-invariant
+   normalizer) is shared VERBATIM from ops/stencil._weno5_weights, so
+   the kernel and the XLA chain cannot drift numerically.
+
+   Strip DMA scheme: strips are DMA'd whole (sublane-aligned, each HBM
+   row read exactly once) into a ring of FOUR VMEM slots; the halo
+   rows of strip i are taken from the resident neighbor strips i-1 and
+   i+1, and strip i+2 prefetches while i computes (4 slots because
+   {i-1, i, i+1, i+2} must be distinct mod the ring size — a ring of 3
+   lets the prefetch overwrite the live top-halo strip). Scratch and
+   DMA semaphores persist across sequential grid steps on TPU and in
+   interpret mode (probed), which is what makes the cross-program ring
+   legal.
+
+   The kernels are leading-dim agnostic like ops/stencil.py: operands
+   are flattened to one leading batch axis L with per-batch
+   (afac, dfac) scale rows, so the SAME kernel serves the solo
+   UniformSim (L=1), member-batched FleetSim (L=B, per-member dt), and
+   — in lab form — forest-block batches (L=N, per-block h). On
+   non-TPU hosts the tier runs in Pallas interpret mode (validation
+   speed, not performance); the sharded x-split path refuses the tier
+   loudly at construction (uniform.UniformGrid) instead of silently
+   computing wrong halos.
+
+   bf16 storage tier: operands stored bf16 in HBM, every VMEM
+   accumulation in f32 (strips are upcast on entry, the final substage
+   result is written back f32). Storage halves the bytes the roofline
+   charges for the dominant reads; the f32 path stays bit-pinned by
+   the goldens.
 """
 
 from __future__ import annotations
@@ -26,7 +57,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .stencil import shift, weno_derivative
+from .stencil import advect_diffuse_core, heun_substage, shift, weno_derivative
 
 try:  # Pallas TPU backend; absent/broken on some hosts -> XLA fallback
     from jax.experimental import pallas as pl
@@ -37,6 +68,28 @@ except Exception:  # pragma: no cover
 
 _G = 3    # WENO5 halo
 _GX = 64  # x halo rounded up to lane alignment (128-multiple DMA widths)
+
+# fused-tier strip heights: the strip DMA slices [k*by, by) must be
+# sublane-aligned, so by is the storage dtype's sublane tile (f32: 8,
+# bf16: 16) — every uniform grid in the repo (bs=8 blocks) divides it
+_BY_F32 = 8
+_BY_BF16 = 16
+
+
+def _rem(k, m):
+    """``k % m`` with both operands pinned i32: program_id is i32, and
+    under x64 (the CPU test harness) a bare Python modulus constant
+    promotes to i64 — interpret-mode stablehlo rejects the mix."""
+    return jax.lax.rem(jnp.asarray(k, jnp.int32), jnp.int32(m))
+
+
+def _on_accel() -> bool:
+    """True when the default device compiles Mosaic kernels (this
+    image's TPU platform registers as 'axon')."""
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False
 
 
 def _core_seq(lab, afac, dfac):
@@ -68,6 +121,10 @@ def _core_seq(lab, afac, dfac):
     return jnp.stack(outs)
 
 
+# ===========================================================================
+# round-4 single-op kernel (pre-padded lab, opt-in history baseline)
+# ===========================================================================
+
 def _adv_kernel(by, bx, nch, fac_ref, vp_ref, out_ref, scratch, sem):
     """One y-strip per grid step; double-buffered DMA over x-chunks so
     copy latency hides behind the WENO chain of the previous chunk."""
@@ -82,7 +139,7 @@ def _adv_kernel(by, bx, nch, fac_ref, vp_ref, out_ref, scratch, sem):
     dma(0, 0).start()
 
     def chunk(c, _):
-        slot = jax.lax.rem(c, 2)
+        slot = _rem(c, 2)
 
         @pl.when(c + 1 < nch)
         def _():
@@ -120,8 +177,9 @@ def _advect_call(vlab_aligned, facs, ny, nx):
         grid=(ny // by,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            # explicit HBM: ANY may pull the whole lab into VMEM
-            pl.BlockSpec(memory_space=pltpu.HBM),
+            # ANY leaves the lab where it lives (HBM at these sizes);
+            # this jax version has no pltpu.HBM token
+            pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         out_specs=pl.BlockSpec((2, by, nx), lambda i: (0, i, 0)),
         out_shape=jax.ShapeDtypeStruct((2, ny, nx), vlab_aligned.dtype),
@@ -133,15 +191,10 @@ def _advect_call(vlab_aligned, facs, ny, nx):
 
 
 def advect_supported(ny: int, nx: int) -> bool:
-    if not HAVE_PALLAS:
-        return False
-    try:
-        # the kernel's DMA idioms are TPU Mosaic only — importing
-        # pallas.tpu succeeds on CPU/GPU hosts, running does not.
-        # (this image's TPU platform is named 'axon'.)
-        if jax.devices()[0].platform not in ("tpu", "axon"):
-            return False
-    except Exception:
+    """Gate for the round-4 single-op kernel: TPU-compiled only (its
+    DMA idioms are Mosaic-specific and it exists for the measured
+    history + parity test, not as a fallback tier)."""
+    if not HAVE_PALLAS or not _on_accel():
         return False
     return bool(_pick(ny, (32, 16, 8))) and bool(
         _pick(nx, (1024, 512, 256, 128)))
@@ -155,3 +208,398 @@ def advect_diffuse_rhs_pallas(vlab, h, nu, dt, nx):
     vlab = jnp.pad(vlab, ((0, 0), (1, 1), (_GX - _G, _GX - _G)))
     facs = jnp.stack([-dt * h, nu * dt]).astype(vlab.dtype)
     return _advect_call(vlab, facs, ny, nx)
+
+
+# ===========================================================================
+# PR-9 megakernel tier
+# ===========================================================================
+
+def fused_tier_supported(ny: int, nx: int, prec: str = "f32") -> bool:
+    """Shape-level gate for the fused substage/correction kernels.
+    Platform-independent: non-TPU hosts run the SAME kernels in
+    interpret mode (the tier is opt-in via CUP2D_PALLAS, so a CPU user
+    who latches it gets correctness-validation speed on purpose). On a
+    compiled TPU the strip DMA additionally needs lane-aligned rows."""
+    if not HAVE_PALLAS:
+        return False
+    by = _BY_BF16 if prec == "bf16" else _BY_F32
+    if ny < by or ny % by:
+        return False
+    if _on_accel() and nx % 128:
+        return False
+    return True
+
+
+def lab_tier_supported(dtype) -> bool:
+    """Gate for the forest-lab RHS kernel: f32 storage only (Mosaic has
+    no f64; the f64 forest validation path stays on XLA)."""
+    return HAVE_PALLAS and jnp.dtype(dtype) == jnp.float32
+
+
+def _substage_kernel(by, n, nx, cfac, ih2, has_vold, out_dtype,
+                     facs_ref, vel_ref, *rest):
+    """One Heun substage on one row strip of one batch member.
+
+    Grid (L, n): batch-major, strips sequential within a member. The
+    velocity is read from HBM exactly once per substage: whole strips
+    (no halo overlap) DMA into a 4-slot ring; strip i's WENO halo rows
+    come from the resident strips i-1 / i+1, or from the free-slip
+    mirror ghosts synthesized in VMEM at the walls. Strip i+2
+    prefetches during strip i's compute (the double-buffering; ring of
+    4 because strips {i-1..i+2} must occupy distinct slots). The lab
+    tile is assembled as VALUES (concatenates), not scratch stores —
+    no unaligned vector stores for Mosaic to choke on."""
+    if has_vold:
+        vold_ref, out_ref, ring, sems, vring, vsems = rest
+    else:
+        out_ref, ring, sems = rest
+
+    l = pl.program_id(0)
+    i = pl.program_id(1)
+    g = _G
+
+    def dma(k):
+        slot = _rem(k, 4)
+        return pltpu.make_async_copy(
+            vel_ref.at[l, :, pl.ds(k * by, by), :],
+            ring.at[slot], sems.at[slot])
+
+    # exactly-once start/wait discipline: dma(k) starts at program
+    # max(0, k-2) and is waited at program max(0, k-1), one program
+    # before its data is first consumed as a bottom halo
+    @pl.when(i == 0)
+    def _():
+        dma(0).start()
+        if n > 1:
+            dma(1).start()
+
+    @pl.when(i + 2 < n)
+    def _():
+        dma(i + 2).start()
+
+    if has_vold:
+        def vdma(k):
+            slot = _rem(k, 2)
+            return pltpu.make_async_copy(
+                vold_ref.at[l, :, pl.ds(k * by, by), :],
+                vring.at[slot], vsems.at[slot])
+
+        @pl.when(i == 0)
+        def _():
+            vdma(0).start()
+
+        @pl.when(i + 1 < n)
+        def _():
+            vdma(i + 1).start()
+
+    @pl.when(i == 0)
+    def _():
+        dma(0).wait()
+        if n > 1:
+            dma(1).wait()
+
+    @pl.when((i > 0) & (i + 1 < n))
+    def _():
+        dma(i + 1).wait()
+
+    if has_vold:
+        vdma(i).wait()
+
+    f32 = jnp.float32
+    cur = ring[_rem(i, 4)].astype(f32)               # [2, by, nx]
+    # neighbor-strip halo rows; the untaken wall branch reads a ring
+    # slot that may be uninitialized — jnp.where only selects, never
+    # computes on the discarded operand
+    prev_t = ring[_rem(i + 3, 4)][:, by - g:, :].astype(f32)
+    next_h = ring[_rem(i + 1, 4)][:, :g, :].astype(f32)
+    # free-slip mirror ghosts (uniform.pad_vector, zeroth-order): all g
+    # ghost rows equal the edge row — u copied, v negated at y walls
+    top_m = jnp.concatenate(
+        [cur[0:1, 0:1, :], -cur[1:2, 0:1, :]], axis=0)
+    bot_m = jnp.concatenate(
+        [cur[0:1, by - 1:by, :], -cur[1:2, by - 1:by, :]], axis=0)
+    top = jnp.where(i > 0, prev_t, jnp.broadcast_to(top_m, (2, g, nx)))
+    bot = jnp.where(i + 1 < n, next_h,
+                    jnp.broadcast_to(bot_m, (2, g, nx)))
+    ycol = jnp.concatenate([top, cur, bot], axis=1)         # [2, by+6, nx]
+    # x ghosts read the y-completed columns so corners compose both
+    # flips, exactly like pad_vector's two-pass sweep: u negated,
+    # v copied at x walls
+    left = jnp.concatenate(
+        [-ycol[0:1, :, 0:1], ycol[1:2, :, 0:1]], axis=0)
+    right = jnp.concatenate(
+        [-ycol[0:1, :, nx - 1:nx], ycol[1:2, :, nx - 1:nx]], axis=0)
+    lab = jnp.concatenate(
+        [jnp.broadcast_to(left, (2, by + 2 * g, g)), ycol,
+         jnp.broadcast_to(right, (2, by + 2 * g, g))], axis=2)
+
+    af = facs_ref[l, 0]
+    df = facs_ref[l, 1]
+    rhs = _core_seq(lab, af, df)
+    if has_vold:
+        vold = vring[_rem(i, 2)].astype(f32)
+    else:
+        vold = cur  # substage 1: vold IS vel — zero extra HBM reads
+    out_ref[0] = heun_substage(vold, cfac, rhs, ih2).astype(out_dtype)
+
+
+def _fused_substage(v, vold, facs, cfac, ih2, out_dtype, interpret):
+    """One megakernel substage over flattened operands.
+    v: [L, 2, ny, nx] (storage dtype); vold: same or None (substage 1,
+    where vold==vel and the ring strip is reused); facs: [L, 2] f32
+    (afac, dfac) per batch member."""
+    L, _, ny, nx = v.shape
+    by = _BY_BF16 if v.dtype == jnp.bfloat16 else _BY_F32
+    n = ny // by
+    has_vold = vold is not None
+    kern = functools.partial(_substage_kernel, by, n, nx,
+                             cfac, ih2, has_vold, jnp.dtype(out_dtype))
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.ANY)]
+    ops = [facs, v]
+    scratch = [pltpu.VMEM((4, 2, by, nx), v.dtype),
+               pltpu.SemaphoreType.DMA((4,))]
+    if has_vold:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        ops.append(vold)
+        scratch += [pltpu.VMEM((2, 2, by, nx), vold.dtype),
+                    pltpu.SemaphoreType.DMA((2,))]
+    return pl.pallas_call(
+        kern,
+        grid=(L, n),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 2, by, nx), lambda l, i: (l, 0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((L, 2, ny, nx), out_dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*ops)
+
+
+def _flatten_lead(shape_lead):
+    L = 1
+    for d in shape_lead:
+        L *= int(d)
+    return max(L, 1)
+
+
+def _per_member(x, lead, L, dtype=jnp.float32):
+    """Normalize a scale factor to a flat [L] row: accepts a scalar, a
+    leading-shaped vector (fleet per-member dt), or the leading shape
+    with trailing singleton broadcast dims (the forest's [N,1,1,1]
+    per-block h)."""
+    x = jnp.asarray(x, dtype)
+    if x.ndim > len(lead):
+        x = x.reshape(x.shape[:len(lead)] + (-1,))[..., 0]
+    return jnp.broadcast_to(x, lead).reshape((L,))
+
+
+def fused_advect_heun(vel, h, nu, dt, *, bf16: bool = False,
+                      interpret=None):
+    """Both Heun substages through the fused megakernel — the drop-in
+    tier for ``UniformGrid.advect_heun`` and the fleet's inlined chain.
+
+    vel: [..., 2, Ny, Nx] (any leading dims); dt: scalar or shaped like
+    the leading dims (per-member fleet dt). f32 path: documented-ulp
+    equivalent to the XLA chain (identical op sequence; the only
+    deviation source is compiler FMA contraction, bound asserted in
+    tests/test_megakernel.py). bf16: storage-precision tier — one
+    upcast-free bf16 read per substage, f32 VMEM accumulation, f32
+    final state."""
+    lead = vel.shape[:-3]
+    L = _flatten_lead(lead)
+    v = vel.reshape((L,) + vel.shape[-3:])
+    dtv = _per_member(dt, lead, L)
+    facs = jnp.stack([-dtv * h, nu * dtv], axis=-1)         # [L, 2] f32
+    ih2 = 1.0 / (h * h)
+    if interpret is None:
+        interpret = not _on_accel()
+    if bf16:
+        vb = v.astype(jnp.bfloat16)
+        v1 = _fused_substage(vb, None, facs, 0.5, ih2,
+                             jnp.bfloat16, interpret)
+        v2 = _fused_substage(v1, vb, facs, 1.0, ih2, v.dtype, interpret)
+    else:
+        v1 = _fused_substage(v, None, facs, 0.5, ih2, v.dtype, interpret)
+        v2 = _fused_substage(v1, v, facs, 1.0, ih2, v.dtype, interpret)
+    return v2.reshape(vel.shape)
+
+
+# ---------------------------------------------------------------------------
+# lab-mode RHS (forest blocks): the AMR stages interleave flux
+# corrections between RHS and update, so the fusable unit is lab -> rhs
+# ---------------------------------------------------------------------------
+
+def _lab_kernel(g, facs_ref, lab_ref, out_ref):
+    af = facs_ref[:, :, :, 0:1]                 # [cb, 1, 1, 1]
+    df = facs_ref[:, :, :, 1:2]
+    out_ref[...] = advect_diffuse_core(lab_ref[...], g, af, df)
+
+
+def fused_lab_rhs(lab, h, nu, dt, *, interpret=None):
+    """Fused advect-diffuse RHS over pre-assembled ghost labs
+    [..., 2, H+2g, W+2g] -> [..., 2, H, W]; ``h`` may be per-block
+    ([N, 1, 1] on the forest) and ``dt`` scalar. One HBM read of the
+    lab per evaluation; block chunks ride the standard Pallas
+    double-buffered pipeline (BlockSpec grid over the leading axis).
+    Shares advect_diffuse_core verbatim with the XLA path."""
+    g = _G
+    lead = lab.shape[:-3]
+    L = _flatten_lead(lead)
+    Hp, Wp = lab.shape[-2:]
+    lab2 = lab.reshape((L, 2, Hp, Wp))
+    a = _per_member(-dt * h, lead, L, lab.dtype).reshape((L, 1, 1))
+    d = _per_member(nu * dt, lead, L, lab.dtype).reshape((L, 1, 1))
+    facs = jnp.stack([a, d], axis=-1)           # [L, 1, 1, 2]
+    cb = _pick(L, (64, 32, 16, 8, 4, 2, 1))
+    if interpret is None:
+        interpret = not _on_accel()
+    kern = functools.partial(_lab_kernel, g)
+    out = pl.pallas_call(
+        kern,
+        grid=(L // cb,),
+        in_specs=[
+            pl.BlockSpec((cb, 1, 1, 2), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((cb, 2, Hp, Wp), lambda i: (i, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((cb, 2, Hp - 2 * g, Wp - 2 * g),
+                               lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (L, 2, Hp - 2 * g, Wp - 2 * g), lab.dtype),
+        interpret=interpret,
+    )(facs, lab2)
+    return out.reshape(lead + out.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# fused projection correction: pres = ((x - mean x) + pold - mean pold),
+# vel += pfac * grad_neumann(pres) * ih2 — one read of x/pold/vel, one
+# write of pres/vel, replacing the XLA mean-free + gradient + update
+# chain's separate passes (poisson.project_correct dispatches here)
+# ---------------------------------------------------------------------------
+
+def _correct_kernel(by, n, ny, nx, ih2, scal_ref, x_ref, p_ref, v_ref,
+                    pres_out, vel_out, xr, xs, pr, ps, vr, vs):
+    l = pl.program_id(0)
+    i = pl.program_id(1)
+
+    def dstrip(ref, ring, sem, k, slots):
+        slot = _rem(k, slots)
+        return pltpu.make_async_copy(
+            ref.at[l, pl.ds(k * by, by), :]
+            if ref is not v_ref else
+            ref.at[l, :, pl.ds(k * by, by), :],
+            ring.at[slot], sem.at[slot])
+
+    def dx(k):
+        return dstrip(x_ref, xr, xs, k, 4)
+
+    def dp(k):
+        return dstrip(p_ref, pr, ps, k, 4)
+
+    def dv(k):
+        return dstrip(v_ref, vr, vs, k, 2)
+
+    @pl.when(i == 0)
+    def _():
+        dx(0).start()
+        dp(0).start()
+        dv(0).start()
+        if n > 1:
+            dx(1).start()
+            dp(1).start()
+
+    @pl.when(i + 2 < n)
+    def _():
+        dx(i + 2).start()
+        dp(i + 2).start()
+
+    @pl.when(i + 1 < n)
+    def _():
+        dv(i + 1).start()
+
+    @pl.when(i == 0)
+    def _():
+        dx(0).wait()
+        dp(0).wait()
+        if n > 1:
+            dx(1).wait()
+            dp(1).wait()
+
+    @pl.when((i > 0) & (i + 1 < n))
+    def _():
+        dx(i + 1).wait()
+        dp(i + 1).wait()
+
+    dv(i).wait()
+
+    f32 = jnp.float32
+    mx = scal_ref[l, 0]
+    mp = scal_ref[l, 1]
+    pfac = scal_ref[l, 2]
+
+    def pt(k, rows):
+        """Mean-free pressure values of strip k's given rows — the
+        exact XLA expression ((x - mx) + pold) - mp."""
+        xv = xr[_rem(k, 4)][rows, :]
+        pv = pr[_rem(k, 4)][rows, :]
+        return ((xv - mx) + pv) - mp
+
+    cur = pt(i, slice(None))                                # [by, nx]
+    # zero-ghost shift rows (the fused-BC zero-shift form): wall rows
+    # are zeros, interior rows come from the neighbor strips
+    top = jnp.where(i > 0, pt(i + 3, slice(by - 1, by)), 0.0)
+    bot = jnp.where(i + 1 < n, pt(i + 1, slice(0, 1)), 0.0)
+    pcol = jnp.concatenate([top, cur, bot], axis=0)         # [by+2, nx]
+    z = jnp.zeros((by + 2, 1), f32)
+    pw = jnp.concatenate([z, pcol, z], axis=1)              # [by+2, nx+2]
+    # rank-1 Neumann edge corrections from GLOBAL indices
+    # (stencil._edge_ones values; 2-D iota — Mosaic has no 1-D iota)
+    col = jax.lax.broadcasted_iota(jnp.int32, (by, nx), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (by, nx), 0) + i * by
+    zero = jnp.zeros((), f32)
+    gx = jnp.where(col == 0, jnp.asarray(-1.0, f32),
+                   jnp.where(col == nx - 1, jnp.asarray(1.0, f32), zero))
+    gy = jnp.where(row == 0, jnp.asarray(-1.0, f32),
+                   jnp.where(row == ny - 1, jnp.asarray(1.0, f32), zero))
+    dpx = (pw[1:-1, 2:] - pw[1:-1, :-2]) + cur * gx
+    dpy = (pw[2:, 1:-1] - pw[:-2, 1:-1]) + cur * gy
+    dv_ = pfac * jnp.stack([dpx, dpy], axis=0)              # [2, by, nx]
+    pres_out[0] = cur
+    vel_out[0] = vr[_rem(i, 2)] + dv_ * ih2
+
+
+def fused_correction(x, pres_old, vel, mx, mp, pfac, ih2, *,
+                     interpret=None):
+    """x, pres_old: [L, Ny, Nx]; vel: [L, 2, Ny, Nx]; mx/mp/pfac: [L]
+    (means and -0.5*dt*h per batch member). Returns (pres, vel)."""
+    L, ny, nx = x.shape
+    by = _BY_F32
+    n = ny // by
+    if interpret is None:
+        interpret = not _on_accel()
+    scal = jnp.stack([mx, mp, pfac], axis=-1).astype(jnp.float32)
+    kern = functools.partial(_correct_kernel, by, n, ny, nx, ih2)
+    f32 = jnp.float32
+    return pl.pallas_call(
+        kern,
+        grid=(L, n),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=[
+            pl.BlockSpec((1, by, nx), lambda l, i: (l, i, 0)),
+            pl.BlockSpec((1, 2, by, nx), lambda l, i: (l, 0, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((L, ny, nx), x.dtype),
+            jax.ShapeDtypeStruct((L, 2, ny, nx), vel.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((4, by, nx), f32), pltpu.SemaphoreType.DMA((4,)),
+            pltpu.VMEM((4, by, nx), f32), pltpu.SemaphoreType.DMA((4,)),
+            pltpu.VMEM((2, 2, by, nx), f32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(scal, x, pres_old, vel)

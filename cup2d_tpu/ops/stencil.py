@@ -224,6 +224,15 @@ def advect_diffuse_core(vlab: jnp.ndarray, g: int, afac, dfac):
     return afac * (wind_u * dx + wind_v * dy) + dfac * lap
 
 
+def heun_substage(vold, cfac, rhs, ih2):
+    """One Heun stage update ``vold + cfac * rhs * ih2`` (rhs in the
+    reference's undivided h^2-scaled form, ih2 = 1/h^2). Trivial on
+    purpose: the expression lives HERE so the XLA drivers (uniform,
+    fleet, amr) and the fused Pallas megakernel all evaluate the same
+    association order — the f32 equivalence goldens pin it."""
+    return vold + cfac * rhs * ih2
+
+
 # ---------------------------------------------------------------------------
 # Fused-BC forms of the LINEAR operators (uniform path).
 #

@@ -751,3 +751,58 @@ def mg_solve(
         converged=sq(converged),
         stalled=sq(stalled),
     )
+
+
+# ---------------------------------------------------------------------------
+# Shared projection-correction epilogue (PR 9)
+# ---------------------------------------------------------------------------
+
+def project_correct(x, pres_old, vel, h, dt, *, spmd_safe=False,
+                    mean_axes=None, tier="xla"):
+    """Post-solve projection epilogue shared by the uniform and fleet
+    drivers: ``pres = (x - mean x) + pres_old - mean pres_old`` and
+    ``vel += -dt/(2h) * grad_neumann(pres) / h^2``.
+
+    x: the solver's deltap field; vel: [..., 2, Ny, Nx]; dt: scalar
+    (uniform) or a flat per-member vector (fleet, with
+    ``mean_axes=(-2, -1)`` selecting per-member means). ``tier`` is the
+    caller's kernel-tier latch: on the fused tiers (f32 state) the
+    whole epilogue after the means runs as ONE Pallas kernel
+    (ops/pallas_kernels.fused_correction — one read of x/pold/vel, one
+    write of pres/vel) instead of the XLA mean-subtract + gradient +
+    update chain. The XLA branch is the historical expression verbatim,
+    so tier="xla" callers are bit-identical to pre-PR-9 code.
+
+    Returns (vel, pres).
+    """
+    from .ops.stencil import pressure_gradient_update_fused
+
+    ih2 = 1.0 / (h * h)
+    if mean_axes is None:
+        mx = jnp.mean(x)
+        mp = jnp.mean(pres_old)
+    else:
+        mx = jnp.mean(x, axis=mean_axes, keepdims=True)
+        mp = jnp.mean(pres_old, axis=mean_axes, keepdims=True)
+    if tier != "xla" and x.dtype == jnp.float32:
+        from .ops.pallas_kernels import fused_correction
+        lead = x.shape[:-2]
+        ny, nx = x.shape[-2:]
+        L = 1
+        for d in lead:
+            L *= int(d)
+        L = max(L, 1)
+        flat = lambda a: jnp.broadcast_to(
+            jnp.asarray(a, jnp.float32), lead + (1, 1)).reshape((L,))
+        dtv = jnp.broadcast_to(
+            jnp.asarray(dt, jnp.float32), lead).reshape((L,))
+        pres, velc = fused_correction(
+            x.reshape((L, ny, nx)), pres_old.reshape((L, ny, nx)),
+            vel.reshape((L, 2, ny, nx)),
+            flat(mx), flat(mp), -0.5 * dtv * h, ih2)
+        return velc.reshape(vel.shape), pres.reshape(x.shape)
+    dt_b = dt[:, None, None, None] if jnp.ndim(dt) == 1 else dt
+    dp = x - mx
+    pres = dp + pres_old - mp
+    dv = pressure_gradient_update_fused(pres, h, dt_b, spmd_safe)
+    return vel + dv * ih2, pres

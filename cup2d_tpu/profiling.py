@@ -345,7 +345,7 @@ class HostCounters:
 # always present; fields that do not apply to a path (AMR shape on a
 # uniform run, comm volume on a single device, counters when disabled)
 # are null — consumers key on names, never on presence.
-METRICS_SCHEMA_VERSION = 5
+METRICS_SCHEMA_VERSION = 6
 METRICS_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     # solver health + timestep state (the step's existing diag pull).
@@ -362,6 +362,12 @@ METRICS_KEYS = (
     # (rides the one diag pull), so an A/B run is attributable from
     # metrics.jsonl alone
     "poisson_mode", "precond_cycles",
+    # kernel-tier attribution (schema v6, PR 9): the ACTIVE advection
+    # kernel tier latch (drivers' .kernel_tier — xla | pallas-fused |
+    # pallas-fused-bf16) and the hot-loop storage-precision contract
+    # (.prec_mode — f32|f64|bf16), so a kernel-tier A/B run is
+    # attributable from metrics.jsonl alone, like poisson_mode
+    "kernel_tier", "prec_mode",
     # fused on-device physics invariants (watchdog inputs)
     "energy", "div_linf",
     # AMR shape
@@ -517,6 +523,13 @@ class MetricsRecorder:
         if pm is None and sim is not None:
             pm = getattr(sim, "poisson_mode", None)
         rec["poisson_mode"] = str(pm) if pm is not None else None
+        # kernel-tier attribution (schema v6): same diag-then-driver
+        # pull as poisson_mode — host strings from constructor latches
+        for key in ("kernel_tier", "prec_mode"):
+            kv = diag.get(key)
+            if kv is None and sim is not None:
+                kv = getattr(sim, key, None)
+            rec[key] = str(kv) if kv is not None else None
         rec.update(self._amr_fields(sim))
         rec.update(self._comm_fields(sim))
         rec.update(self._counter_fields())

@@ -365,6 +365,26 @@ class PhysicsWatchdog:
         self.energy: deque = deque(maxlen=self.window)
         self.div: deque = deque(maxlen=self.window)
 
+    @classmethod
+    def for_prec(cls, prec_mode: str, **kw) -> "PhysicsWatchdog":
+        """Tolerance band matched to the driver's storage-precision
+        contract (``sim.prec_mode``, PR 9). The bf16 tier's legitimate
+        step-to-step invariant jitter is ~2^-8 relative (bf16 mantissa)
+        instead of f32's ~2^-23, so its windows settle later and sit
+        wider: the settle ratios and the one-sided divergence factor
+        loosen. The CORRUPTION factors stay put where they bound
+        corruption scale, not rounding (a 4x energy cliff inside an
+        8-step window is corrupt in any precision); div_factor doubles
+        because the projection's reachable divergence floor — the
+        window baseline the factor multiplies — is itself noisier at
+        bf16 storage. Explicit ``**kw`` overrides win."""
+        if prec_mode == "bf16":
+            kw.setdefault("umax_settle", 2.5)
+            kw.setdefault("energy_settle", 2.5)
+            kw.setdefault("div_settle", 8.0)
+            kw.setdefault("div_factor", 100.0)
+        return cls(**kw)
+
     def _armed(self, hist: deque, settle: float):
         """(hi, lo) when the invariant's window is full and settled,
         else None — drift bounds only mean something against a stable

@@ -133,6 +133,16 @@ class Simulation(ShapeHostMixin):
         """Active solve-path latch (telemetry schema v4)."""
         return self.grid.poisson_mode
 
+    @property
+    def kernel_tier(self) -> str:
+        """Active advection-kernel tier (telemetry schema v6)."""
+        return self.grid.kernel_tier
+
+    @property
+    def prec_mode(self) -> str:
+        """Hot-loop storage precision (telemetry schema v6)."""
+        return self.grid.prec_mode
+
     # ------------------------------------------------------------------
     # device: rasterization + chi + integrals (ongrid, main.cpp:4208-4630)
     # ------------------------------------------------------------------

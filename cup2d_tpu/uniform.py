@@ -29,8 +29,8 @@ from .ops.stencil import (
     divergence_freeslip,
     divergence_rhs_fused,
     dt_from_umax,
+    heun_substage,
     laplacian5_neumann,
-    pressure_gradient_update_fused,
     vorticity,
 )
 from .poisson import (
@@ -39,6 +39,7 @@ from .poisson import (
     bicgstab,
     block_precond_matrix,
     mg_solve,
+    project_correct,
 )
 
 
@@ -119,10 +120,13 @@ def taylor_green_state(grid) -> "FlowState":
 class UniformGrid:
     """Geometry + jitted operators for one uniform resolution.
 
-    ``use_pallas`` (or env CUP2D_PALLAS=1) swaps the advection RHS for
-    the hand-tiled Pallas kernel — measured at parity-minus on v5e (the
-    op is VPU-divide-bound, see ops/pallas_kernels.py), so XLA is the
-    default."""
+    ``use_pallas`` (or env CUP2D_PALLAS=1) swaps the whole advection +
+    projection-correction chain for the fused Pallas megakernel tier
+    (ops/pallas_kernels.fused_advect_heun): one HBM read, one write per
+    RK substage. CUP2D_PREC=bf16 additionally stores the advection
+    operands bf16 (f32 accumulation). On non-TPU hosts the tier runs
+    in Pallas interpret mode — validation, not speed. XLA remains the
+    default tier."""
 
     def __init__(self, cfg: SimConfig, level: Optional[int] = None,
                  use_pallas: Optional[bool] = None,
@@ -135,11 +139,49 @@ class UniformGrid:
         lvl = cfg.level_start if level is None else level
         if use_pallas is None:
             use_pallas = os.environ.get("CUP2D_PALLAS", "") == "1"
+        # storage-precision latch for the fused tier (the ONE sanctioned
+        # CUP2D_PREC read site — tests/test_env_latch.py): bf16 is a
+        # property of the megakernel's HBM operands, meaningless without
+        # the tier, so requesting it tier-less fails loudly.
+        prec = os.environ.get("CUP2D_PREC", "") or "f32"
+        if prec not in ("f32", "bf16"):
+            raise ValueError(f"CUP2D_PREC={prec!r}: expected f32|bf16")
+        if prec == "bf16" and not use_pallas:
+            raise ValueError(
+                "CUP2D_PREC=bf16 selects the bf16-storage variant of the "
+                "fused Pallas tier; set CUP2D_PALLAS=1 (or use_pallas=True)"
+                " or drop CUP2D_PREC")
+        tier = "xla"
         if use_pallas:
-            from .ops.pallas_kernels import advect_supported
-            use_pallas = advect_supported(
-                cfg.bpdy * cfg.bs << lvl, cfg.bpdx * cfg.bs << lvl)
-        self.use_pallas = bool(use_pallas)
+            if spmd_safe:
+                # composition gap closed LOUDLY (ISSUE 9): the strip
+                # kernel synthesizes free-slip wall ghosts from global
+                # row/col position — under the sharded x-split each
+                # shard would mirror at an interior halo seam and
+                # silently compute wrong physics. Refuse at
+                # construction; the sharded path keeps its XLA chain.
+                raise ValueError(
+                    "CUP2D_PALLAS=1 does not compose with the sharded "
+                    "x-split (spmd_safe=True): the fused kernel's wall-"
+                    "ghost synthesis is global, not shard-local. Unset "
+                    "CUP2D_PALLAS for sharded runs.")
+            ny = cfg.bpdy * cfg.bs << lvl
+            nx = cfg.bpdx * cfg.bs << lvl
+            from .ops.pallas_kernels import fused_tier_supported
+            ok = (jnp.dtype(cfg.dtype) == jnp.float32
+                  and fused_tier_supported(ny, nx, prec=prec))
+            if ok:
+                tier = "pallas-fused-bf16" if prec == "bf16" \
+                    else "pallas-fused"
+            elif prec == "bf16":
+                raise ValueError(
+                    f"CUP2D_PREC=bf16 unsupported for this grid "
+                    f"({cfg.dtype} {ny}x{nx}): the bf16 tier needs f32 "
+                    "state and sublane-aligned strips (ny % 16 == 0)")
+            # f32 shape/dtype misses keep the historical silent-XLA
+            # fallback (the tier is an optimization, not a semantic)
+        self._kernel_tier = tier
+        self.use_pallas = tier != "xla"   # back-compat bool alias
         # Poisson solve-path latch (read ONCE here, the AMRSim.__init__
         # pattern — tests/test_env_latch.py sanctions this site): the
         # uniform/fleet/sharded-uniform drivers accept "fas"/"fas-f"
@@ -233,6 +275,22 @@ class UniformGrid:
             return "fas-f" if self.fas_fmg else "fas"
         return "bicgstab+mg" if self.cfg.precond else "bicgstab"
 
+    @property
+    def kernel_tier(self) -> str:
+        """Active advection-kernel tier latch (telemetry schema v6):
+        xla | pallas-fused | pallas-fused-bf16."""
+        return self._kernel_tier
+
+    @property
+    def prec_mode(self) -> str:
+        """Storage-precision contract of the advection hot loop
+        (telemetry schema v6): the bf16 tier stores HBM operands bf16
+        (f32 accumulation); otherwise the state dtype."""
+        if self._kernel_tier == "pallas-fused-bf16":
+            return "bf16"
+        return {"float32": "f32", "float64": "f64"}.get(
+            self.dtype.name, self.dtype.name)
+
     def attach_mesh(self, mesh) -> None:
         """Give the MG hierarchy the device mesh so the FAS path runs
         its finest-level smoothing sweeps with the explicit overlapped
@@ -284,18 +342,21 @@ class UniformGrid:
 
     # -- step stages, shared by the obstacle-free and Simulation paths --
     def advect_heun(self, vel: jnp.ndarray, dt) -> jnp.ndarray:
-        """Advection-diffusion, 2-stage Heun (main.cpp:6607-6642)."""
+        """Advection-diffusion, 2-stage Heun (main.cpp:6607-6642).
+        On the fused tier both substages run as Pallas megakernels
+        (one HBM read/write per substage) instead of the
+        pad -> WENO-RHS -> update dispatch chain."""
+        if self._kernel_tier != "xla":
+            from .ops.pallas_kernels import fused_advect_heun
+            return fused_advect_heun(
+                vel, self.h, self.cfg.nu, dt,
+                bf16=self._kernel_tier == "pallas-fused-bf16")
         ih2 = 1.0 / (self.h * self.h)
         vold = vel
         for c in (0.5, 1.0):
             lab = pad_vector(vel, 3)
-            if self.use_pallas:
-                from .ops.pallas_kernels import advect_diffuse_rhs_pallas
-                rhs = advect_diffuse_rhs_pallas(
-                    lab, self.h, self.cfg.nu, dt, self.nx)
-            else:
-                rhs = advect_diffuse_rhs(lab, 3, self.h, self.cfg.nu, dt)
-            vel = vold + c * rhs * ih2
+            rhs = advect_diffuse_rhs(lab, 3, self.h, self.cfg.nu, dt)
+            vel = heun_substage(vold, c, rhs, ih2)
         return vel
 
     def project(self, vel, pres_old, chi, udef, dt, exact_poisson=False):
@@ -319,10 +380,10 @@ class UniformGrid:
         div_linf = jnp.max(jnp.abs(b)) * (dt / (h * h))
         b = b - laplacian5_neumann(pres_old, self.spmd_safe)
         res = self.pressure_solve(b, exact=exact_poisson)
-        dp = res.x - jnp.mean(res.x)
-        pres = dp + pres_old - jnp.mean(pres_old)
-        dv = pressure_gradient_update_fused(pres, h, dt, self.spmd_safe)
-        return vel + dv * ih2, pres, res, div_linf
+        vel, pres = project_correct(
+            res.x, pres_old, vel, h, dt,
+            spmd_safe=self.spmd_safe, tier=self._kernel_tier)
+        return vel, pres, res, div_linf
 
     def precond_cycles(self, res, exact):
         """Preconditioner/MG cycle count of one solve (telemetry
@@ -438,6 +499,16 @@ class UniformSim:
     def poisson_mode(self) -> str:
         """Active solve-path latch (telemetry schema v4)."""
         return self.grid.poisson_mode
+
+    @property
+    def kernel_tier(self) -> str:
+        """Active advection-kernel tier (telemetry schema v6)."""
+        return self.grid.kernel_tier
+
+    @property
+    def prec_mode(self) -> str:
+        """Hot-loop storage precision (telemetry schema v6)."""
+        return self.grid.prec_mode
 
     def step_once(self, dt: Optional[float] = None):
         """One supervised-loop-compatible step (the StepGuard driver

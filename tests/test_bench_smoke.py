@@ -35,6 +35,8 @@ def test_bench_cpu_smoke():
         BENCH_FLEET_SIZE="16",
         BENCH_FLEET_STEPS="5",
         BENCH_POISSON_SIZE="32",         # tiny solver micro-curve
+        BENCH_KERNEL_SIZE="32",          # kernel-tier curve, interpret mode
+        BENCH_KERNEL_REPS="1",
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py")],
@@ -71,6 +73,28 @@ def test_bench_cpu_smoke():
     for name, p in pc["paths"].items():
         assert p["converged"], (name, p)
         assert p["iters"] >= 1 and p["ms_per_solve"] > 0, (name, p)
+    # advection kernel-tier curve (PR 9): all three tiers present (the
+    # fused tiers run the REAL kernels in Pallas interpret mode on the
+    # CPU box, so this pins the plumbing, schema, and bytes model)
+    kc = out["kernel_curve"]
+    assert "error" not in kc, kc
+    assert kc["interpret_mode"] is True          # CPU box
+    assert set(kc["tiers"]) == {"xla", "pallas_fused",
+                                "pallas_fused_bf16"}
+    for name, tr in kc["tiers"].items():
+        assert tr["ms_per_substage"] > 0, (name, tr)
+        assert set(tr) >= {"adv_field_reads", "adv_field_writes",
+                           "hbm_bytes", "hbm_util_pct", "mfu_pct",
+                           "storage_dtype"}, (name, tr)
+    # the ISSUE-9 acceptance, asserted from the bytes model: the XLA
+    # chain re-reads the advected field >= 3x per substage where the
+    # megakernel reads it ONCE, and the modeled HBM bytes drop
+    assert kc["tiers"]["xla"]["adv_field_reads"] >= 3
+    assert kc["tiers"]["pallas_fused"]["adv_field_reads"] == 1
+    assert (kc["tiers"]["pallas_fused"]["hbm_bytes"]
+            < kc["tiers"]["xla"]["hbm_bytes"])
+    assert (kc["tiers"]["pallas_fused_bf16"]["hbm_bytes"]
+            < kc["tiers"]["pallas_fused"]["hbm_bytes"])
 
 
 @pytest.mark.slow   # ~5 s subprocess; the satellite's tier-1 ask is

@@ -30,11 +30,20 @@ SANCTIONED_SITES = {
     # UniformGrid constructor is the ONE uniform-side latch; fleet.py
     # and the parallel/ modules read the GRID's stored latch and stay
     # env-read-free (this walk enforces it).
-    ("amr.py", "AMRSim.__init__"): {"CUP2D_POIS", "CUP2D_TWOLEVEL"},
-    # per-grid constructor latches (stored as self.use_pallas /
-    # self.solver_mode+self.fas_fmg)
+    # CUP2D_PALLAS (PR 9): the forest's own fused-tier latch — the
+    # lab-mode megakernel dispatch in _advect_rk2 reads the stored
+    # self._kernel_tier, never the env
+    ("amr.py", "AMRSim.__init__"): {"CUP2D_POIS", "CUP2D_TWOLEVEL",
+                                    "CUP2D_PALLAS"},
+    # per-grid constructor latches (stored as self._kernel_tier /
+    # self.solver_mode+self.fas_fmg). CUP2D_PREC (PR 9) is the
+    # storage-precision contract of the fused tier: ONE read site in
+    # the whole package — fleet/mesh/bench consume the grid's stored
+    # tier string, so a mid-run env mutation can never flip the
+    # precision of a compiled step
     ("uniform.py", "UniformGrid.__init__"): {"CUP2D_PALLAS",
-                                             "CUP2D_POIS"},
+                                             "CUP2D_POIS",
+                                             "CUP2D_PREC"},
     # the fault-injection latch (PR 7 tightened faults.py from a
     # whole-file sanction to this one scope): every injector —
     # including the elastic host_exit/host_hang tokens — parses from

@@ -55,7 +55,7 @@ def _amr_sim():
 # schema stability (golden key set): every producer emits the SAME keys
 # ---------------------------------------------------------------------------
 
-# the LITERAL schema-v5 key set: METRICS_KEYS is the producers' truth,
+# the LITERAL schema-v6 key set: METRICS_KEYS is the producers' truth,
 # this tuple is the consumers' — any drift between them (a key renamed,
 # dropped, or added without bumping the schema) fails here on purpose.
 # v3 added the fleet-batching fields (fleet_members / member_steps_per_s
@@ -63,13 +63,17 @@ def _amr_sim():
 # (poisson_mode — the active CUP2D_POIS latch + trigger state — and the
 # per-step preconditioner-cycle count, PR 6); v5 the elastic-topology
 # group (topology_epoch / remesh_count / remesh_ms — the TopologyGuard
-# + StepGuard.elastic_recover subsystem, PR 7).
-_SCHEMA_V5_KEYS = (
+# + StepGuard.elastic_recover subsystem, PR 7); v6 the kernel-tier
+# attribution pair (kernel_tier — the active CUP2D_PALLAS megakernel
+# latch — and prec_mode, the CUP2D_PREC storage-precision contract,
+# PR 9).
+_SCHEMA_V6_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     "umax", "dt_next",
     "poisson_iters", "poisson_residual",
     "poisson_converged", "poisson_stalled",
     "poisson_mode", "precond_cycles",
+    "kernel_tier", "prec_mode",
     "energy", "div_linf",
     "n_blocks", "blocks_per_level", "refines", "coarsens",
     "halo_real_bytes", "halo_padded_bytes",
@@ -81,10 +85,10 @@ _SCHEMA_V5_KEYS = (
 )
 
 
-def test_metrics_schema_v5_key_set_pinned():
+def test_metrics_schema_v6_key_set_pinned():
     from cup2d_tpu.profiling import METRICS_SCHEMA_VERSION
-    assert METRICS_SCHEMA_VERSION == 5
-    assert METRICS_KEYS == _SCHEMA_V5_KEYS
+    assert METRICS_SCHEMA_VERSION == 6
+    assert METRICS_KEYS == _SCHEMA_V6_KEYS
 
 
 def test_metrics_schema_stable_uniform_amr_bench():
@@ -105,6 +109,11 @@ def test_metrics_schema_stable_uniform_amr_bench():
     # preconditioner twice per iteration)
     assert r["poisson_mode"] == "bicgstab+mg"
     assert r["precond_cycles"] == 2 * r["poisson_iters"]
+    # schema v6 kernel-tier attribution: the driver's constructor
+    # latches ride the same pull (default environment: XLA tier, and
+    # prec_mode reports the f64 state dtype of _cfg)
+    assert r["kernel_tier"] == "xla"
+    assert r["prec_mode"] == "f64"
 
     # forest driver path
     asim = _amr_sim()
