@@ -24,6 +24,20 @@ own CFL dt; dumps write one reference-format triplet per member,
 ``vel.NNNNNNNN.mK``. Obstacle-free only: ``-shapes`` with ``-fleet``
 is an error).
 
+``-serve N`` (with ``-fleet B``, PR 11) switches the fleet to
+CONTINUOUS-BATCHING SERVING (fleet.FleetServer): N staggered-horizon
+sessions flow through the fixed-B slot pool — each admitted into a
+free slot, stepped under the per-slot active mask, retired at its own
+``t_end`` with a bit-exact session checkpoint in
+``<output>/sessions/<client>/`` (resumable via admit-from-checkpoint),
+and the freed slot refilled from the queue, all with ZERO steady-state
+recompiles (the mask and slot indices are device operands). A member
+whose recovery ladder exhausts is EVICTED (slot freed, ``member_evict``
+event) instead of aborting the fleet. Telemetry gains the schema-v7
+serving gauges (active_members/occupancy/admitted/evicted/queue_depth)
+plus one per-client JSONL stream each under ``<output>/clients/``;
+SIGTERM parks every live session as its checkpoint and exits 0.
+
 MULTI-DEVICE & ELASTIC (parallel/, PR 7): ``-mesh N|all`` runs the
 sharded drivers (ShardedUniformSim / ShardedAMRSim) over an N-device
 (or every-device) 1-D mesh; multi-process bring-up takes
@@ -95,6 +109,16 @@ def main(argv=None) -> int:
     p = CommandlineParser(argv)
     cfg = SimConfig.from_argv(argv)
     fleet_n = p("fleet").asInt() if p.has("fleet") else 0
+    serve_n = p("serve").asInt() if p.has("serve") else 0
+    if serve_n and not fleet_n:
+        print("cup2d_tpu: -serve N needs -fleet B (the slot pool it "
+              "serves through)", file=sys.stderr)
+        return 2
+    if serve_n and p.has("restart"):
+        print("cup2d_tpu: -serve resumes per-session (admit from "
+              "<output>/sessions/<client>), not from a whole-fleet "
+              "-restart", file=sys.stderr)
+        return 2
     uniform = fleet_n > 0 or p.has("level") or cfg.level_max <= 1
     outdir = p("output").asString() if p.has("output") else "."
     ckpt_every = p("checkpointEvery").asInt() if p.has("checkpointEvery") \
@@ -156,10 +180,12 @@ def main(argv=None) -> int:
         from .fleet import FleetSim
         level = p("level").asInt() if p.has("level") else cfg.level_start
         sim = FleetSim(cfg, level=level, members=fleet_n)
-        if not p.has("restart"):
+        if not p.has("restart") and not serve_n:
             # obstacle-free zero state would be a trivial run: seed the
             # amplitude-laddered Taylor-Green ensemble (per-member umax
-            # -> per-member dt, the no-lockstep contract live)
+            # -> per-member dt, the no-lockstep contract live). A
+            # SERVING run starts empty instead — sessions arrive
+            # through the FleetServer queue
             sim.seed_taylor_green()
     elif uniform:
         level = p("level").asInt() if p.has("level") else cfg.level_start
@@ -259,6 +285,31 @@ def main(argv=None) -> int:
         lag=not p.has("noLag"),
     )
 
+    # -serve N: continuous-batching serving — N staggered-horizon
+    # sessions flow through the B-slot pool (admit/retire/evict churn,
+    # zero steady-state recompiles). The server wires the guard's
+    # eviction rung (on_member_abort) in its constructor.
+    server = None
+    if serve_n:
+        from .fleet import (FleetRequest, FleetServer, FlowState,
+                            taylor_green_fleet)
+        server = FleetServer(
+            sim, guard=guard,
+            session_dir=os.path.join(outdir, "sessions"),
+            event_log=log,
+            clients_dir=os.path.join(outdir, "clients"))
+        # the session ladder: Taylor-Green at geometrically decaying
+        # amplitudes (per-session umax -> per-session dt) with horizons
+        # staggered across [tend/2, tend] so retirements interleave
+        # with admissions (real churn, not one synchronized wave)
+        ens = taylor_green_fleet(sim.grid, serve_n)
+        for i in range(serve_n):
+            t_end = cfg.end_time * (0.5 + 0.5 * (i + 1) / serve_n)
+            server.submit(FleetRequest(
+                client_id=f"s{i:04d}",
+                state=FlowState(*(a[i] for a in ens)),
+                t_end=t_end))
+
     # telemetry: on unless -noMetrics; the record rides the step's one
     # existing batched diag pull — under the lagged verdict the record
     # for step N is emitted when its verdict lands (during step N+1's
@@ -277,7 +328,8 @@ def main(argv=None) -> int:
         metrics_log = EventLog(metrics_path)
         counters = HostCounters().install()
         recorder = MetricsRecorder(sink=metrics_log, counters=counters,
-                                   timers=sim.timers, guard=guard)
+                                   timers=sim.timers, guard=guard,
+                                   server=server)
         recorder.prime(sim)
 
     def record(rec, wall_ms=None):
@@ -303,8 +355,46 @@ def main(argv=None) -> int:
 
     rc = 0
     try:
+        if server is not None:
+            # serving loop: refill / fused step / retire each cycle.
+            # No -tdump schedule here — a session's artifact is its
+            # save-on-retire checkpoint (sessions/<client>), and its
+            # telemetry its per-client stream (clients/<client>.jsonl).
+            # FleetStepGuard forces the eager verdict (lag=False), so
+            # there is never a pending verdict between cycles and
+            # admit/retire/evict always land on settled state.
+            while ((server.queue or server.active.any())
+                   and sim.step_count < max_steps):
+                if stop.agree():
+                    n_parked = server.park_all()
+                    log.emit(event="sigterm_park", step=sim.step_count,
+                             parked=n_parked, queued=len(server.queue),
+                             signum=stop.signum)
+                    print(f"cup2d_tpu: SIGTERM at step "
+                          f"{sim.step_count} — {n_parked} live "
+                          f"session(s) parked under "
+                          f"{os.path.join(outdir, 'sessions')}, "
+                          f"{len(server.queue)} still queued, exiting "
+                          "cleanly", file=sys.stderr)
+                    return 0
+                if sim.step_count % 5 == 0:
+                    print(f"cup2d_tpu: {sim.step_count:08d} serving "
+                          f"{int(server.active.sum())}/{sim.members} "
+                          f"slots, queue={len(server.queue)}, "
+                          f"retired={server.retired}, "
+                          f"evicted={server.evicted}", file=sys.stderr)
+                t_step = time.perf_counter()
+                rec = server.step()
+                if rec is None:
+                    break
+                record(rec,
+                       wall_ms=1e3 * (time.perf_counter() - t_step))
+            print(f"cup2d_tpu: served {server.admitted} session(s): "
+                  f"{server.retired} retired, {server.evicted} "
+                  f"evicted, {len(server.queue)} unserved",
+                  file=sys.stderr)
         next_dump = sim.time if cfg.dump_time > 0 else float("inf")
-        while True:
+        while server is None:   # the classic (non-serving) run loop
             if not (sim.time < cfg.end_time
                     and sim.step_count < max_steps):
                 # loop end — or a lagged NaN clock; the drain settles
@@ -414,6 +504,8 @@ def main(argv=None) -> int:
         rc = 1
     finally:
         stop.uninstall()
+        if server is not None:
+            server.close()   # flush/close the per-client streams
         if tracer is not None:
             tracer.close()   # a window past tend must not leak a trace
         if sim.force_log is not None and not sim.force_log.closed:

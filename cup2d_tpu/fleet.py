@@ -65,6 +65,9 @@ stencil forms), where the member axis rides along replicated.
 
 from __future__ import annotations
 
+import os
+from collections import deque
+from dataclasses import dataclass
 from typing import Optional
 
 import jax
@@ -76,6 +79,7 @@ from .ops.pallas_kernels import fused_advect_heun
 from .ops.stencil import (
     advect_diffuse_rhs,
     divergence_freeslip,
+    divergence_rhs_fused,
     dt_from_umax,
     heun_substage,
     laplacian5_neumann,
@@ -123,12 +127,16 @@ class FleetSim:
 
     def __init__(self, cfg: SimConfig, level: Optional[int] = None,
                  members: int = 1, mesh=None, placement: str = "auto",
-                 member_cells_cap: int = 1 << 22):
+                 member_cells_cap: int = 1 << 22, shaped: bool = False):
         if members < 1:
             raise ValueError(f"need members >= 1, got {members}")
         self.cfg = cfg
         self.members = int(members)
         self.mesh = mesh
+        # shaped membership: per-member obstacle chi/us/udef fields ride
+        # the member axis as FROZEN solids (the moving-shape update loop
+        # stays solo/AMR-side; the ROADMAP keeps the padded-forest half)
+        self.shaped = bool(shaped)
         lvl = cfg.level_start if level is None else level
         nx = cfg.bpdx * cfg.bs << lvl
         ny = cfg.bpdy * cfg.bs << lvl
@@ -160,6 +168,13 @@ class FleetSim:
         self.times = np.zeros(self.members, dtype=np.float64)
         self.time = 0.0           # min over members (the loop condition)
         self.step_count = 0       # shared: one dispatch = one step for all
+        # slot-pool mask (FleetServer): host truth + device mirror.
+        # ``_active=None`` keeps the historical unmasked trace; once
+        # set_active() is called the mask is ALWAYS passed as a [B]
+        # device operand so admit/evict churn never changes the jit
+        # signature (zero steady-state recompiles)
+        self.active_mask = np.ones(self.members, dtype=bool)
+        self._active = None
         self.shapes: list = []    # obstacle-free by construction
         self.timers = None
         self.force_log = None
@@ -192,6 +207,36 @@ class FleetSim:
             g.step, donate_argnums=(0,),
             static_argnames=("exact_poisson", "obstacle_terms"))
         self._member_dt = jax.jit(g.compute_dt)
+        # slot-pool gather/scatter (FleetServer admit/retire churn):
+        # ONE fused executable each, slot index as a device int32
+        # operand (any slot, same executable) and the fleet state
+        # DONATED on install — an admit/retire costs one dispatch, not
+        # a per-field op chain plus a full-state copy
+        self._extract_member = jax.jit(
+            lambda state, idx: FlowState(*(a[idx] for a in state)))
+        self._install_member = jax.jit(
+            lambda state, idx, st: FlowState(
+                *(a.at[idx].set(v) for a, v in zip(state, st))),
+            donate_argnums=(0,))
+        self._scatter_next_dt = jax.jit(
+            lambda nd, idx, v: nd.at[idx].set(v), donate_argnums=(0,))
+        # the one-dispatch admit: state install + chained-dt scatter
+        # fused, dtv <= 0 meaning "compute the fresh CFL dt from the
+        # admitted velocity right here" (bit-identical to
+        # grid.compute_dt: the max reduce is order-invariant and
+        # dt_from_umax elementwise)
+        self._admit_impl = jax.jit(
+            lambda state, nd, idx, st, dtv: (
+                FlowState(*(a.at[idx].set(v)
+                            for a, v in zip(state, st))),
+                nd.at[idx].set(jnp.where(dtv > 0, dtv,
+                                         g.compute_dt(st.vel)))),
+            donate_argnums=(0, 1))
+        # per-slot device indices, transferred once: admit/retire churn
+        # re-uses them so a slot op is one dispatch with zero fresh h2d
+        self._idx = [jnp.asarray(m, jnp.int32)
+                     for m in range(self.members)]
+        self._dt_sentinel = jnp.zeros((), g.dtype)  # "fresh dt" flag
 
     # -- fused member-batched step core -------------------------------
     def _dt_impl(self, vel: jnp.ndarray) -> jnp.ndarray:
@@ -256,14 +301,35 @@ class FleetSim:
         )
 
     def _step_impl(self, state: FlowState, dt: jnp.ndarray,
-                   exact_poisson: bool = False):
+                   active=None, exact_poisson: bool = False):
         """One fused step of every member: Heun advection-diffusion +
-        deltap projection (obstacle-free — the identically-zero
-        penalization/chi terms are statically dropped, like
-        ``UniformGrid.step(obstacle_terms=False)``). ``dt`` is [B]."""
+        deltap projection. Obstacle-free by default (the
+        identically-zero penalization/chi terms are statically dropped,
+        like ``UniformGrid.step(obstacle_terms=False)``); under
+        ``shaped=True`` the Brinkman penalization and the chi-weighted
+        divergence RHS ride the member axis (per-member obstacles).
+        ``dt`` is [B].
+
+        ``active`` (None or a [B] bool vector) is the slot-pool mask:
+        inactive slots still ride the fused dispatch — the executable
+        is shape-stable across arbitrary admit/evict churn — but every
+        one of their outputs is select-frozen to the input state, the
+        same trick ``poisson.bicgstab``/``mg_solve`` use for converged
+        members. ``active=None`` traces the exact historical unmasked
+        graph (bit-preserving for the fixed-B drivers); an all-True
+        mask is itself bit-identical to unmasked (``where(True, new,
+        old)`` selects ``new`` verbatim), so a serving fleet at full
+        occupancy pays nothing but the selects."""
         g = self.grid
         h = g.h
         ih2 = 1.0 / (h * h)
+        dt_req = dt
+        if active is not None:
+            # a dead slot's cached dt_next lane can be anything (an
+            # evicted member leaves NaN behind): give dead lanes a
+            # finite dt so their lane arithmetic stays NaN-free, and
+            # select-freeze every output below
+            dt = jnp.where(active, dt, jnp.ones_like(dt))
         dt3 = dt[:, None, None]            # broadcast vs [B, Ny, Nx]
         dt4 = dt[:, None, None, None]      # broadcast vs [B, 2, Ny, Nx]
 
@@ -283,15 +349,43 @@ class FleetSim:
                 rhs = advect_diffuse_rhs(lab, 3, h, g.cfg.nu, dt4)
                 vel = heun_substage(vold, c, rhs, ih2)
 
-        # -- deltap pressure projection (chi == 0) --
-        b = (0.5 * h / dt3) * divergence_freeslip(vel, g.spmd_safe)
+        # -- deltap pressure projection --
+        if self.shaped:
+            # Brinkman penalization, member-batched (the SAME scalar
+            # chain as UniformGrid.step's obstacle_terms=True branch,
+            # so a shaped member matches its solo run to the documented
+            # FMA bound): chi/us/udef ride the member axis as frozen
+            # per-member obstacle fields
+            alpha = jnp.where(state.chi > 0.5,
+                              1.0 / (1.0 + g.cfg.lam * dt3), 1.0)
+            vel = alpha[:, None] * vel + (1.0 - alpha)[:, None] * state.us
+            b = divergence_rhs_fused(vel, state.udef, state.chi, h, dt3,
+                                     g.spmd_safe)
+        else:
+            b = (0.5 * h / dt3) * divergence_freeslip(vel, g.spmd_safe)
         div_linf = jnp.max(jnp.abs(b), axis=(-2, -1)) * (dt / (h * h))
         b = b - laplacian5_neumann(state.pres, g.spmd_safe)
+        if active is not None:
+            # zero the dead rows of the Poisson RHS: their initial
+            # residual is 0 <= max(tol, tol_rel*0), so the
+            # member-batched solvers mark them done AT ITERATION ZERO
+            # with inert diag (iters=0, residual=0, converged) and the
+            # existing converged-member freeze keeps their lanes exact
+            # identity through every sweep the live members need
+            b = jnp.where(active[:, None, None], b, jnp.zeros_like(b))
         res = self._pressure_solve(b, exact_poisson)
         vel, pres = project_correct(
             res.x, state.pres, vel, h, dt,
             spmd_safe=g.spmd_safe, mean_axes=(-2, -1),
             tier=g.kernel_tier)
+        if active is not None:
+            # freeze dead slots: state, diag and clock all read the
+            # UNSTEPPED values (bit-exact slot preservation under
+            # arbitrary co-member churn)
+            vel = jnp.where(active[:, None, None, None], vel, state.vel)
+            pres = jnp.where(active[:, None, None], pres, state.pres)
+            div_linf = jnp.where(active, div_linf,
+                                 jnp.zeros_like(div_linf))
 
         # -- per-member diag (the one batched pull's payload) --
         umax = jnp.max(jnp.abs(vel), axis=(-3, -2, -1))
@@ -314,6 +408,13 @@ class FleetSim:
             "dt_next": dt_from_umax(umax, jnp.asarray(h, g.dtype),
                                     g.cfg.nu, g.cfg.cfl),
         }
+        if active is not None:
+            # the per-member clock increments ride the one pull: a dead
+            # slot advances by exactly 0.0 (its host clock freezes with
+            # its state); the requested dt — NaN lanes included — never
+            # reaches the times accumulator
+            diag["dt"] = jnp.where(active, dt_req,
+                                   jnp.zeros_like(dt_req))
         return state._replace(vel=vel, pres=pres), diag
 
     # -- driver contract (StepGuard-compatible) -----------------------
@@ -336,9 +437,14 @@ class FleetSim:
             timers = NULL_TIMERS
         with timers.phase("step"):
             self.state, diag = self._step(self.state, dt_dev,
+                                          self._active,
                                           exact_poisson=exact)
             diag = dict(diag)
-            diag["dt"] = dt_dev   # rides the one pull (per-member clocks)
+            if "dt" not in diag:
+                # unmasked path: every slot advances by the dispatched
+                # dt (the masked trace returns its own zeroed-dead-lane
+                # vector from inside the jit)
+                diag["dt"] = dt_dev   # rides the one pull
             self._next_dt = diag["dt_next"]
             if self.async_diag:
                 # -profile must still attribute device time to the
@@ -350,25 +456,79 @@ class FleetSim:
                 return diag
             diag = jax.device_get(diag)   # the natural phase fence
         self.times = self.times + np.asarray(diag["dt"], np.float64)
-        self.time = float(self.times.min())
+        self.time = self._fleet_time()
         self.step_count += 1
         return diag
 
-    # -- per-member access (the guard's slice rewind path) ------------
+    def _fleet_time(self) -> float:
+        """The loop-condition clock: min over LIVE slots — a retired
+        slot's frozen clock must not pin the fleet time at its
+        retirement point (empty pool: min over all, i.e. unchanged)."""
+        act = self.active_mask
+        if act.all() or not act.any():
+            return float(self.times.min())
+        return float(self.times[act].min())
+
+    def set_active(self, mask) -> None:
+        """Install the per-slot active mask (FleetServer lifecycle).
+        From the first call on, the fused step runs the masked trace
+        permanently — including at full occupancy, where the all-True
+        selects are bit-identity — so slot churn re-uses ONE compiled
+        executable."""
+        m = np.asarray(mask, dtype=bool)
+        if m.shape != (self.members,):
+            raise ValueError(
+                f"active mask shape {m.shape} != ({self.members},)")
+        if self._active is not None \
+                and np.array_equal(m, self.active_mask):
+            # the device mirror already holds this pattern — in steady
+            # full-pool churn a retire at one cycle's end and the
+            # refill at the next cycle's start cancel out, so the mask
+            # usually never changes value and the h2d push is skipped
+            return
+        self.active_mask = m.copy()
+        self._active = jnp.asarray(self.active_mask)
+
+    # -- per-member access (guard rewind + server admit/retire) -------
+    # The slot index is passed as a DEVICE int32 operand, not a Python
+    # int: a baked int index would compile one gather/scatter
+    # executable per distinct slot, and the serving loop's admit/evict
+    # churn touches arbitrary slots — with the index as an operand, ONE
+    # executable covers the whole pool (the zero-recompile contract).
     def member_state(self, m: int) -> FlowState:
         """Member ``m``'s slice as a solo FlowState (fresh arrays)."""
-        return FlowState(*(a[m] for a in self.state))
+        return self._extract_member(self.state, self._idx[m])
 
     def set_member_state(self, m: int, st: FlowState) -> None:
         """Install a solo FlowState into member ``m``'s slice; every
-        other member's values pass through bit-unchanged."""
-        self.state = FlowState(*(a.at[m].set(v)
-                                 for a, v in zip(self.state, st)))
+        other member's values pass through bit-unchanged (one donated
+        fused scatter — the old state buffers are reused in place)."""
+        self.state = self._install_member(self.state, self._idx[m], st)
 
     def set_member_next_dt(self, m: int, dt_next) -> None:
-        if self._next_dt is not None:
-            self._next_dt = jnp.asarray(self._next_dt).at[m].set(
-                jnp.asarray(dt_next, self.grid.dtype))
+        if self._next_dt is None:
+            # materialize the cache so a pre-first-step admission's dt
+            # lands in it: the other lanes get exactly the dt step_once
+            # would have computed from the current velocities
+            self._next_dt = self._dt(self.state.vel)
+        self._next_dt = self._scatter_next_dt(
+            jnp.asarray(self._next_dt), self._idx[m],
+            jnp.asarray(dt_next, self.grid.dtype))
+
+    def admit_member(self, m: int, st: FlowState,
+                     next_dt=None) -> None:
+        """The serving hot path: install ``st`` into slot ``m`` AND
+        scatter its chained dt in ONE donated dispatch.
+        ``next_dt=None`` computes the fresh CFL dt from the admitted
+        velocity inside the same executable (bit-identical to
+        ``grid.compute_dt`` on the solo slice)."""
+        if self._next_dt is None:
+            self._next_dt = self._dt(self.state.vel)
+        dtv = (self._dt_sentinel if next_dt is None
+               else jnp.asarray(next_dt, self.grid.dtype))
+        self.state, self._next_dt = self._admit_impl(
+            self.state, jnp.asarray(self._next_dt), self._idx[m],
+            st, dtv)
 
     def member_step_once(self, m: int, dt=None, exact: bool = False):
         """Advance ONLY member ``m`` one step through the solo
@@ -382,7 +542,8 @@ class FleetSim:
             dt = float(self._member_dt(st.vel))
         st, diag = self._member_step(
             st, jnp.asarray(dt, self.grid.dtype),
-            exact_poisson=bool(exact), obstacle_terms=False)
+            exact_poisson=bool(exact),
+            obstacle_terms=bool(self.shaped))
         self.set_member_state(m, st)
         diag = dict(diag)
         diag["dt"] = float(dt)
@@ -398,3 +559,259 @@ class FleetSim:
             st = FlowState(*(jax.device_put(np.asarray(a), b.sharding)
                              for a, b in zip(st, self.state)))
         self.state = st
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching slot-pool serving
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FleetRequest:
+    """One client session waiting for a fleet slot.
+
+    Exactly one of ``state`` / ``checkpoint`` provides the admission
+    state: ``state`` is a solo :class:`FlowState` at clock ``t0``;
+    ``checkpoint`` is a per-member session directory written by
+    ``io.save_member_checkpoint`` — admission from it resumes the
+    session bit-exact (state, clock and the chained per-member dt all
+    round-trip losslessly). The member is retired once its clock
+    reaches ``t_end``; ``next_dt`` (optional) overrides the first
+    step's dt (otherwise the checkpoint's chained dt, else a fresh CFL
+    dt from the admitted velocity)."""
+    client_id: str
+    state: Optional[FlowState] = None
+    checkpoint: Optional[str] = None
+    t0: float = 0.0
+    t_end: float = float("inf")
+    next_dt: Optional[float] = None
+
+
+class FleetServer:
+    """Continuous-batching serving loop over a ``FleetSim`` slot pool.
+
+    The inference-stack pattern on a flow fleet: a FIXED-B padded pool
+    whose step executable never changes shape, with a per-slot active
+    mask (``FleetSim.set_active``). Finished members retire (their
+    session checkpoint lands in ``session_dir``), aborted members are
+    EVICTED by the guard's per-member ladder (``on_member_abort`` —
+    the slot is freed instead of the fleet dying), and free slots
+    refill from the request queue — all without recompiling: the mask
+    is a device operand, slot installs/slices run through
+    device-int32-indexed executables, and dead lanes are select-frozen
+    inside the fused step. A live member's trajectory is bit-identical
+    regardless of co-member churn (its lane's arithmetic is
+    elementwise-independent and dead/alive co-lanes only change values
+    OTHER lanes never read).
+
+    Lifecycle events (``member_admit`` / ``member_retire`` /
+    ``member_evict``) go to ``event_log``; the serving gauges ride the
+    schema-v7 metrics record (``telemetry_fields``); per-client JSONL
+    streams split out of ``member_health`` when ``clients_dir`` is set
+    (profiling.ClientStreams — the MetricsRecorder writes them).
+    """
+
+    def __init__(self, sim: FleetSim, *, guard=None,
+                 session_dir: Optional[str] = None,
+                 event_log=None, clients_dir: Optional[str] = None):
+        self.sim = sim
+        self.guard = guard
+        if guard is not None:
+            # wire the eviction rung: an exhausted per-member ladder
+            # frees the slot (member_aborted event) instead of raising
+            guard.on_member_abort = self._on_member_abort
+        self.session_dir = session_dir
+        self.event_log = event_log
+        self.queue: deque = deque()
+        self.active = np.zeros(sim.members, dtype=bool)
+        self.t_end = np.full(sim.members, np.inf)
+        self.client: list = [None] * sim.members
+        self.admitted = 0
+        self.retired = 0
+        self.evicted = 0
+        self.step_clients: list = [None] * sim.members
+        self.clients = None
+        if clients_dir is not None:
+            from .profiling import ClientStreams
+            self.clients = ClientStreams(clients_dir)
+        # one cached zero template: EVICTION re-zeroes the slot through
+        # the same one-executable scatter admission uses (an aborted
+        # member's NaN state must not leak into the masked step's
+        # member_health diag rows). Plain retirement skips the zero —
+        # the parked contents are the retiree's final state, finite,
+        # mask-frozen, and fully overwritten by the next admit — so a
+        # retire costs ZERO dispatches.
+        self._zero = sim.grid.zero_state()
+        # device-mask sync is coalesced: slot changes mark the mask
+        # dirty and step() pushes it ONCE per cycle before dispatch
+        self._mask_dirty = False
+        sim.set_active(self.active)
+
+    # -- client API ---------------------------------------------------
+    def submit(self, req: FleetRequest) -> None:
+        """Enqueue a session; it is admitted at the next free slot."""
+        self.queue.append(req)
+
+    def client_of(self, m: int):
+        """The client id occupying slot ``m`` (None when free)."""
+        return self.client[m]
+
+    @property
+    def occupancy(self) -> float:
+        return float(self.active.sum()) / self.sim.members
+
+    def telemetry_fields(self) -> dict:
+        """The schema-v7 serving gauges (host-side, no device work)."""
+        return {
+            "active_members": int(self.active.sum()),
+            "occupancy": round(self.occupancy, 6),
+            "admitted": int(self.admitted),
+            "evicted": int(self.evicted),
+            "queue_depth": len(self.queue),
+        }
+
+    def close(self) -> None:
+        if self.clients is not None:
+            self.clients.close()
+
+    # -- slot lifecycle -----------------------------------------------
+    def _emit(self, **fields) -> None:
+        if self.event_log is not None:
+            self.event_log.emit(**fields)
+
+    def _fill_slots(self) -> int:
+        n = 0
+        for m in range(self.sim.members):
+            if not self.queue:
+                break
+            if not self.active[m]:
+                self._admit(m, self.queue.popleft())
+                n += 1
+        return n
+
+    def _admit(self, slot: int, req: FleetRequest) -> None:
+        sim = self.sim
+        meta: dict = {}
+        if req.checkpoint is not None:
+            from .io import load_member_checkpoint
+            st, meta = load_member_checkpoint(req.checkpoint, sim.grid)
+        else:
+            st = req.state
+        if st is None:
+            raise ValueError(
+                f"request {req.client_id!r}: neither state nor "
+                "checkpoint provided")
+        t0 = float(meta.get("time", req.t0))
+        sim.times[slot] = t0
+        nd = req.next_dt if req.next_dt is not None \
+            else meta.get("next_dt")
+        sim.admit_member(slot, st, nd)
+        self.active[slot] = True
+        self._mask_dirty = True
+        self.client[slot] = req.client_id
+        self.t_end[slot] = float(req.t_end)
+        self.admitted += 1
+        if self.guard is not None:
+            # the slot's watchdog history belongs to the RETIRED
+            # occupant — a fresh session starts with a fresh clone
+            self.guard.reset_member_watchdog(slot)
+        self._emit(event="member_admit", member=slot,
+                   client=req.client_id, t0=t0, t_end=float(req.t_end))
+
+    def _free_slot(self, slot: int, zero: bool = False) -> None:
+        if zero:   # eviction only — see the _zero comment in __init__
+            self.sim.set_member_state(slot, self._zero)
+        self.active[slot] = False
+        self._mask_dirty = True
+        self.client[slot] = None
+        self.t_end[slot] = np.inf
+
+    def _retire(self, slot: int) -> None:
+        cid = self.client[slot]
+        ckpt = None
+        if self.session_dir is not None:
+            from .io import save_member_checkpoint
+            ckpt = os.path.join(self.session_dir, str(cid))
+            save_member_checkpoint(ckpt, self.sim, slot)
+        t_done = float(self.sim.times[slot])
+        self._free_slot(slot)
+        self.retired += 1
+        if self.clients is not None:
+            self.clients.close(cid)
+        self._emit(event="member_retire", member=slot, client=cid,
+                   t=t_done, checkpoint=ckpt)
+
+    def _on_member_abort(self, m: int, reason: str, step: int) -> None:
+        """The guard's eviction hook (per-member ladder exhausted):
+        free the slot and count the eviction. The guard re-anchors its
+        snapshot ring right after this returns, so the fresh anchor
+        already holds the zeroed dead slot and the healthy members'
+        live states — their trajectories and clocks pass through
+        bit-unchanged."""
+        cid = self.client[m]
+        self._free_slot(m, zero=True)
+        self.evicted += 1
+        # sync NOW, not lazily: the guard is mid-step and its replay
+        # of the surviving members runs against the device mask
+        self.sim.set_active(self.active)
+        self._mask_dirty = False
+        if self.clients is not None:
+            self.clients.close(cid)
+        self._emit(event="member_evict", member=m, client=cid,
+                   reason=reason, step=step)
+
+    # -- the serving loop ---------------------------------------------
+    def step(self) -> Optional[dict]:
+        """One serving cycle: refill free slots from the queue, advance
+        the whole pool one fused step, retire members whose clocks
+        crossed their horizon. Returns the step record (None when the
+        pool is empty and the queue has nothing to admit)."""
+        if self._fill_slots() and self.guard is not None:
+            # fresh anchor AFTER admissions: a later rewind must
+            # restore the admitted state, never pre-admit slot contents
+            self.guard.reanchor()
+        if not self.active.any():
+            return None
+        if self._mask_dirty:
+            # ONE device-mask push per cycle, however many slots the
+            # admissions/retirements above flipped
+            self.sim.set_active(self.active)
+            self._mask_dirty = False
+        rec = (self.guard.step() if self.guard is not None
+               else self.sim.step_once())
+        # who occupied each slot DURING this fused step: the recorder
+        # runs after step() returns, by which time a retiring member's
+        # slot is already cleared — its final step's telemetry row
+        # must still reach its client stream (times[] keeps the
+        # retiree's final clock until the next cycle's refill)
+        self.step_clients = list(self.client)
+        done = np.flatnonzero(self.active
+                              & (self.sim.times >= self.t_end))
+        for m in done:
+            self._retire(int(m))   # mask push deferred to next cycle
+        return rec
+
+    def park_all(self) -> int:
+        """Retire every live member NOW (the CLI's preemption path):
+        each session's checkpoint lands in ``session_dir``, resumable
+        bit-exact via admit-from-checkpoint; the queue is left to the
+        caller (requests hold no device state). Returns the number of
+        sessions parked."""
+        live = np.flatnonzero(self.active)
+        for m in live:
+            self._retire(int(m))
+        if live.size:
+            self.sim.set_active(self.active)
+        return int(live.size)
+
+    def drain(self, *, max_steps: Optional[int] = None) -> int:
+        """Serve until the queue is empty and every slot has retired
+        (or ``max_steps`` serving cycles elapsed). Returns the number
+        of fused steps taken."""
+        n = 0
+        while self.queue or self.active.any():
+            if max_steps is not None and n >= max_steps:
+                break
+            if self.step() is None:
+                break
+            n += 1
+        return n

@@ -461,6 +461,92 @@ def _install_state(sim, data, meta: dict, shapes) -> None:
 
 
 # ---------------------------------------------------------------------------
+# per-member session checkpoints (fleet serving, PR 11)
+# ---------------------------------------------------------------------------
+# A serving client's session must survive its slot: the FleetServer
+# saves one of these on retire and a FleetRequest(checkpoint=...) admits
+# from it — into ANY live fleet, any slot, bit-exact (state, the
+# member's own clock, and its chained dt all round-trip losslessly; the
+# f64 JSON repr round-trip is exact, and a float of an f32 lane is
+# exact in double both ways). Layout mirrors save_checkpoint
+# (fields.npz + meta.json, tmp -> park -> replace install) but holds
+# ONE member's solo-shaped slice, so the same session can also be
+# resumed standalone.
+
+def save_member_checkpoint(dirpath: str, sim, m: int) -> None:
+    """Serialize fleet member ``m``'s session to ``dirpath``."""
+    st = sim.member_state(m)
+    payload = {k: _to_host_global(v)
+               for k, v in st._asdict().items()}
+    meta = {
+        "kind": "member",
+        "time": float(sim.times[m]),
+        "step_count": int(sim.step_count),
+        "config": {k: v for k, v in vars(sim.cfg).items()
+                   if not k.startswith("_")},
+        "next_dt": (float(np.asarray(sim._next_dt)[m])
+                    if sim._next_dt is not None else None),
+    }
+    if not _is_writer():
+        _sync_processes("save_member_checkpoint")
+        return
+    import shutil
+    tmp = dirpath.rstrip("/") + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    np.savez(os.path.join(tmp, "fields.npz"), **payload)
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    # same crash-safe swap order as save_checkpoint: at every instant
+    # either dirpath or dirpath+'.old' holds a complete session
+    old = dirpath.rstrip("/") + ".old"
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    if os.path.exists(dirpath):
+        os.replace(dirpath, old)
+    os.replace(tmp, dirpath)
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    _sync_processes("save_member_checkpoint")
+
+
+def load_member_checkpoint(dirpath: str, grid):
+    """Read a member session -> (solo FlowState, meta dict). ``grid``
+    supplies the dtype (the state is cast exactly as a fleet admission
+    would install it); falls back to ``dirpath.old`` like
+    load_checkpoint."""
+    import sys
+
+    import jax.numpy as jnp
+
+    if not os.path.exists(os.path.join(dirpath, "meta.json")):
+        old = dirpath.rstrip("/") + ".old"
+        if os.path.exists(os.path.join(old, "meta.json")):
+            print(f"cup2d_tpu: member checkpoint {dirpath!r} missing or "
+                  f"incomplete; falling back to parked copy {old!r}",
+                  file=sys.stderr)
+            from .resilience import record_event
+            record_event(event="checkpoint_fallback_old",
+                         requested=dirpath, used=old)
+            dirpath = old
+    with open(os.path.join(dirpath, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("kind") != "member":
+        raise ValueError(
+            f"{dirpath!r} is not a member session checkpoint "
+            f"(kind={meta.get('kind')!r})")
+    from .uniform import FlowState
+    with np.load(os.path.join(dirpath, "fields.npz")) as data:
+        # jnp.array (copy) for the same donation-safety reason as
+        # _install_state: the admitted slice feeds executables that
+        # donate their operands
+        st = FlowState(**{k: jnp.array(data[k], dtype=grid.dtype)
+                          for k in FlowState._fields})
+    return st, meta
+
+
+# ---------------------------------------------------------------------------
 # device-resident snapshots (the StepGuard's HBM ring, resilience.py)
 # ---------------------------------------------------------------------------
 # The PR-2 host ring gathered the full state to host RAM per good step

@@ -61,11 +61,25 @@ def render(path: str, png_path: str | None = None,
 
 def metrics_summary(path: str) -> dict:
     """Aggregate one metrics.jsonl stream (profiling.summarize_metrics
-    + the source path)."""
-    from .profiling import load_metrics, summarize_metrics
+    + the source path). A serving run's per-client streams (schema v7:
+    a ``clients/`` directory next to the metrics file, one
+    ``<client>.jsonl`` each — profiling.ClientStreams) are summarized
+    per client under ``clients``."""
+    import os
+
+    from .profiling import (load_metrics, summarize_client,
+                            summarize_metrics)
 
     out = summarize_metrics(load_metrics(path))
     out["source"] = path
+    cdir = os.path.join(os.path.dirname(os.path.abspath(path)),
+                        "clients")
+    if os.path.isdir(cdir):
+        out["clients"] = {
+            fn[:-len(".jsonl")]: summarize_client(
+                load_metrics(os.path.join(cdir, fn)))
+            for fn in sorted(os.listdir(cdir))
+            if fn.endswith(".jsonl")}
     return out
 
 

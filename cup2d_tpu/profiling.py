@@ -345,7 +345,7 @@ class HostCounters:
 # always present; fields that do not apply to a path (AMR shape on a
 # uniform run, comm volume on a single device, counters when disabled)
 # are null — consumers key on names, never on presence.
-METRICS_SCHEMA_VERSION = 6
+METRICS_SCHEMA_VERSION = 7
 METRICS_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     # solver health + timestep state (the step's existing diag pull).
@@ -394,9 +394,20 @@ METRICS_KEYS = (
     # dispatch — THE dispatch-amortization metric), and per-member
     # solver health folded into the one record as {key: [B values]}
     "fleet_members", "member_steps_per_s", "member_health",
+    # fleet serving (schema v7, fleet.FleetServer): slot-pool gauges —
+    # live member count, occupancy fraction of the padded pool,
+    # cumulative admissions/evictions, and the request-queue depth.
+    # Null outside -serve. With per-client streams attached
+    # (ClientStreams) the per-member rows move to clients/<id>.jsonl
+    # and member_health above is null on serving records.
+    "active_members", "occupancy", "admitted", "evicted",
+    "queue_depth",
     # merged PhaseTimers wall times (per-step deltas, ms)
     "phase_ms",
 )
+
+_SERVE_KEYS = ("active_members", "occupancy", "admitted", "evicted",
+               "queue_depth")
 
 _DIAG_KEYS = ("umax", "dt_next", "poisson_iters", "poisson_residual",
               "poisson_converged", "poisson_stalled", "energy",
@@ -450,11 +461,13 @@ class MetricsRecorder:
     cached per topology version, and counters/timers are host state."""
 
     def __init__(self, sink=None, counters: Optional[HostCounters] = None,
-                 timers: Optional[PhaseTimers] = None, guard=None):
+                 timers: Optional[PhaseTimers] = None, guard=None,
+                 server=None):
         self.sink = sink
         self.counters = counters
         self.timers = timers
         self.guard = guard          # resilience.StepGuard, opt-in
+        self.server = server        # fleet.FleetServer, opt-in (v7)
         self._last_time: Optional[float] = None
         self._last_counters = counters.snapshot() if counters else None
         self._last_phase: dict = dict(timers.acc) if timers else {}
@@ -538,11 +551,45 @@ class MetricsRecorder:
         rec["member_steps_per_s"] = (
             round(fleet_b * 1e3 / wall_ms, 3)
             if fleet_b and wall_ms else None)
+        # fleet serving gauges (schema v7): host state on the server;
+        # null slots on every non-serving record
+        serve = (self.server.telemetry_fields()
+                 if self.server is not None else {})
+        for k in _SERVE_KEYS:
+            rec[k] = serve.get(k)
+        if (self.server is not None and self.server.clients is not None
+                and member_health is not None):
+            # the per-client split (schema v7): per-member rows ride
+            # their own JSONL streams keyed by client id — the
+            # aggregate record keeps only the conservative folds
+            self._emit_client_rows(rec, member_health)
+            member_health = None
         rec["member_health"] = member_health
         rec["phase_ms"] = self._phase_fields()
         if self.sink is not None:
             self.sink.emit(event="metrics", **rec)
         return rec
+
+    def _emit_client_rows(self, rec: dict, member_health: dict) -> None:
+        """One JSONL row per slot that was OCCUPIED during the recorded
+        step (``server.step_clients`` — a member retiring at the end of
+        that very step must still get its final row; ``client_of`` is
+        already cleared by then): the member's slice of the pulled diag
+        vectors plus its own clock (``sim.times[m]`` — the aggregate
+        record's ``t`` is only the pool min; the retiree's final clock
+        survives until the next cycle's refill). Slots parked for the
+        whole step have no client and emit nothing."""
+        srv = self.server
+        sim = srv.sim
+        nm = len(next(iter(member_health.values())))
+        for m in range(nm):
+            cid = srv.step_clients[m]
+            if cid is None:
+                continue
+            row = {k: v[m] for k, v in member_health.items()}
+            srv.clients.emit(cid, {
+                "event": "metrics", "client": str(cid), "member": m,
+                "step": rec["step"], "t": float(sim.times[m]), **row})
 
     def _amr_fields(self, sim) -> dict:
         f = getattr(sim, "forest", None)
@@ -624,6 +671,82 @@ class MetricsRecorder:
         return out
 
 
+class ClientStreams:
+    """Per-client JSONL telemetry (schema v7): one append-only stream
+    per serving client id under ``dirpath``, written by the
+    MetricsRecorder's serving split — the per-member rows that used to
+    exist only folded inside the aggregate record's ``member_health``.
+    A session's telemetry thereby survives slot reuse (the slot index
+    is an allocator detail; the client id is the identity) and is
+    readable per client by ``post --metrics``."""
+
+    def __init__(self, dirpath: str):
+        self.dir = dirpath
+        os.makedirs(dirpath, exist_ok=True)
+        self._files: dict = {}
+
+    @staticmethod
+    def _fname(cid) -> str:
+        # client ids come from the request queue — sanitize into a flat
+        # filename (no separators, no dot-prefix surprises)
+        s = "".join(c if c.isalnum() or c in "-_." else "_"
+                    for c in str(cid))
+        return (s or "client").lstrip(".") + ".jsonl"
+
+    def path_of(self, cid) -> str:
+        return os.path.join(self.dir, self._fname(cid))
+
+    def emit(self, cid, rec: dict) -> None:
+        f = self._files.get(cid)
+        if f is None:
+            f = open(self.path_of(cid), "a")
+            self._files[cid] = f
+        f.write(json.dumps(rec, sort_keys=True, default=float) + "\n")
+        f.flush()
+
+    def close(self, cid=None) -> None:
+        """Close one client's stream (retire/evict) or all of them."""
+        files = ([self._files.pop(cid)] if cid in self._files
+                 else list(self._files.values()) if cid is None else [])
+        if cid is None:
+            self._files.clear()
+        for f in files:
+            if not f.closed:
+                f.close()
+
+
+def summarize_client(records: list) -> dict:
+    """Aggregate one client stream (clients/<id>.jsonl rows) into the
+    per-client summary ``post --metrics`` reports: session extent,
+    clock, dt/solver-health stats — the per-member slice analogue of
+    :func:`summarize_metrics`."""
+    recs = [r for r in records if r.get("event", "metrics") == "metrics"]
+
+    def col(key):
+        return [r[key] for r in recs if r.get(key) is not None]
+
+    def stats(xs):
+        if not xs:
+            return None
+        return {"mean": round(float(np.mean(xs)), 6),
+                "max": round(float(np.max(xs)), 6)}
+
+    return {
+        "steps": len(recs),
+        "t_first": recs[0]["t"] if recs else None,
+        "t_final": recs[-1]["t"] if recs else None,
+        "dt": stats(col("dt")),
+        "umax_max": (max(col("umax")) if col("umax") else None),
+        "energy_last": (col("energy")[-1] if col("energy") else None),
+        "poisson_iters": stats(col("poisson_iters")),
+        "poisson_residual_max": (max(col("poisson_residual"))
+                                 if col("poisson_residual") else None),
+        "div_linf_max": (max(col("div_linf"))
+                         if col("div_linf") else None),
+        "finite_all": (all(col("finite")) if col("finite") else None),
+    }
+
+
 def load_metrics(path: str) -> list:
     """All JSONL records from ``path`` (mixed event streams are fine;
     `summarize_metrics` filters for ``event == "metrics"``)."""
@@ -701,5 +824,15 @@ def summarize_metrics(records: list) -> dict:
         "fleet_members": (col("fleet_members")[-1]
                           if col("fleet_members") else None),
         "member_steps_per_s": stats(col("member_steps_per_s")),
+        # fleet serving (schema v7): occupancy stats of the slot pool +
+        # final lifecycle counters (admitted/evicted are cumulative
+        # gauges — the last value is the run total)
+        "active_members": stats(col("active_members")),
+        "occupancy": stats(col("occupancy")),
+        "admitted_total": (col("admitted")[-1]
+                           if col("admitted") else None),
+        "evicted_total": (col("evicted")[-1]
+                          if col("evicted") else None),
+        "queue_depth": stats(col("queue_depth")),
     }
     return out

@@ -926,9 +926,9 @@ class StepGuard:
             return "disk_restore"
         return "abort"
 
-    def _emit(self, **fields) -> None:
+    def _emit(self, event: str = "recovery", **fields) -> None:
         if self.event_log is not None:
-            self.event_log.emit(event="recovery",
+            self.event_log.emit(event=event,
                                 sim_time=float(self.sim.time), **fields)
 
     def _abort(self, step: int, v: StepVerdict, vals: dict,
@@ -1090,15 +1090,47 @@ class FleetStepGuard(StepGuard):
 
     Injected ``poisson_giveup`` faults flag member 0 (the same member
     ``faults.poison_velocity``/``scale_velocity`` target on a fleet).
+
+    Serving mode (``on_member_abort=``, wired by ``fleet.FleetServer``):
+    the exhausted ladder EVICTS the one bad member — ``member_aborted``
+    event, callback frees the slot, the fleet lives on — instead of
+    raising :class:`ResilienceAbort`. Slots masked inactive by the
+    server are skipped by the per-member verdicts and watchdogs (their
+    lanes are select-frozen identity; classifying a parked slot's
+    stale diag would evict ghosts).
     """
 
-    def __init__(self, sim, *, watchdog=None, **kw):
+    def __init__(self, sim, *, watchdog=None, on_member_abort=None,
+                 **kw):
         kw["lag"] = False     # eager by design — see the docstring
         super().__init__(sim, watchdog=None, **kw)
         import copy
+        self._watchdog_proto = watchdog
         self.member_watchdogs = (
             [copy.deepcopy(watchdog) for _ in range(sim.members)]
             if watchdog is not None else None)
+        self.on_member_abort = on_member_abort
+        self.evictions = 0
+
+    def _member_active(self, m: int) -> bool:
+        act = getattr(self.sim, "active_mask", None)
+        return True if act is None else bool(act[m])
+
+    def reset_member_watchdog(self, m: int) -> None:
+        """Fresh watchdog clone for slot ``m`` (server admission: the
+        slot's history belongs to the previous occupant)."""
+        if self.member_watchdogs is not None:
+            import copy
+            self.member_watchdogs[m] = copy.deepcopy(self._watchdog_proto)
+
+    def reanchor(self) -> None:
+        """Fresh snapshot anchor + clean replay base. The server calls
+        this after an admission batch so a later per-member rewind can
+        never restore PRE-admit slot contents (the eager fleet verdict
+        guarantees no dispatch is in flight between steps)."""
+        self.ring.append(self._snapshot())
+        self._replay.clear()
+        self._since_snap = 0
 
     # -- vectorized verdict -------------------------------------------
     def _resolve_oldest(self) -> dict:
@@ -1133,6 +1165,10 @@ class FleetStepGuard(StepGuard):
             self._one_member_verdict(
                 m, {k: v[m] for k, v in vals.items() if np.ndim(v) >= 1},
                 step)
+            if self._member_active(m)
+            # parked slot: its lane is select-frozen identity — always
+            # healthy by construction, never classified
+            else StepVerdict(True, "inactive")
             for m in range(self.sim.members)]
 
     def _commit(self, pend: _Pending, vals: dict) -> dict:
@@ -1140,13 +1176,15 @@ class FleetStepGuard(StepGuard):
         dts = np.asarray(vals["dt"], np.float64)
         if not pend.advanced:
             # async path: settle every member's clock from the pulled
-            # per-member dt vector (commits run in step order)
+            # per-member dt vector (commits run in step order; a dead
+            # slot's pulled dt is exactly 0.0 — its clock freezes)
             sim.times = sim.times + dts
-            sim.time = float(sim.times.min())
+            sim.time = sim._fleet_time()
         if self.member_watchdogs is not None:
             for m in range(sim.members):
-                self.member_watchdogs[m].observe(
-                    {k: v[m] for k, v in vals.items()})
+                if self._member_active(m):
+                    self.member_watchdogs[m].observe(
+                        {k: v[m] for k, v in vals.items()})
         if pend.snap is not None:
             # capture-time clocks were lagged — settle them now
             pend.snap.meta["time"] = sim.time
@@ -1198,10 +1236,10 @@ class FleetStepGuard(StepGuard):
                     vals[k][m] = val
         if self.member_watchdogs is not None:
             for m in range(sim.members):
-                if verdicts[m].ok:
+                if verdicts[m].ok and self._member_active(m):
                     self.member_watchdogs[m].observe(
                         {k: v[m] for k, v in vals.items()})
-        sim.time = float(sim.times.min())
+        sim.time = sim._fleet_time()
         # every member healthy again: fresh anchor, clean replay base
         self.ring.append(self._snapshot())
         self._replay.clear()
@@ -1219,6 +1257,14 @@ class FleetStepGuard(StepGuard):
         while True:
             if not self.recover or rung >= 2:
                 self._abort_member(m, step0, v, vals, dt_used)
+                # eviction (serving mode): the slot is free, the fleet
+                # lives on — patch the record with an inert lane so the
+                # fold aggregates don't carry the dead member's NaNs
+                return {"dt": 0.0, "dt_next": 1.0, "finite": True,
+                        "umax": 0.0, "energy": 0.0, "div_linf": 0.0,
+                        "poisson_iters": 0, "poisson_residual": 0.0,
+                        "poisson_stalled": False,
+                        "poisson_converged": True, "precond_cycles": 0}
             replayed = self._rewind_member(m, anchor)
             exact = rung == 1
             retry_dt = (0.5 * dt_used
@@ -1264,6 +1310,11 @@ class FleetStepGuard(StepGuard):
         with ctx:
             for rdts, rexact, _ in self._replay:
                 rdt = float(np.asarray(rdts)[m])
+                if rdt == 0.0:
+                    # the member sat parked (masked dead) for this
+                    # recorded step: its lane was frozen identity, so
+                    # replay is a no-op for it
+                    continue
                 sim.member_step_once(m, dt=rdt, exact=rexact)
                 sim.times[m] += rdt
                 n += 1
@@ -1273,6 +1324,24 @@ class FleetStepGuard(StepGuard):
     def _abort_member(self, m: int, step: int, v: StepVerdict,
                       vals: dict, dt_used: float) -> None:
         sim = self.sim
+        summary = {k: _as_float(np.asarray(vals[k])[m])
+                   for k in ("umax", "poisson_residual", "poisson_iters")
+                   if k in vals}
+        if self.on_member_abort is not None:
+            # serving mode: EVICT the one bad member. The callback
+            # (FleetServer._on_member_abort) zeroes the slot and masks
+            # it dead; scrubbing the dt cache keeps the evicted lane's
+            # NaN out of the next dispatch's operands (the masked step
+            # would sanitize it anyway — this keeps the cache clean for
+            # the host side too). Healthy members never rewound, and
+            # _recover_members re-anchors on the post-eviction state.
+            self._emit(event="member_aborted", step=step, member=m,
+                       verdict=v.reason, action="evict", dt=dt_used,
+                       diag=summary)
+            self.evictions += 1
+            self.on_member_abort(m, v.reason, step)
+            sim.set_member_next_dt(m, 1.0)
+            return
         pm = None
         if self.postmortem_dir:
             try:
@@ -1285,9 +1354,6 @@ class FleetStepGuard(StepGuard):
         flog = getattr(sim, "force_log", None)
         if flog is not None and not flog.closed:
             flog.close()
-        summary = {k: _as_float(np.asarray(vals[k])[m])
-                   for k in ("umax", "poisson_residual", "poisson_iters")
-                   if k in vals}
         self._emit(step=step, member=m, verdict=v.reason,
                    action="abort", dt=dt_used, postmortem=pm,
                    diag=summary)

@@ -66,8 +66,10 @@ def _amr_sim():
 # + StepGuard.elastic_recover subsystem, PR 7); v6 the kernel-tier
 # attribution pair (kernel_tier — the active CUP2D_PALLAS megakernel
 # latch — and prec_mode, the CUP2D_PREC storage-precision contract,
-# PR 9).
-_SCHEMA_V6_KEYS = (
+# PR 9); v7 the continuous-batching serving gauges (active_members /
+# occupancy / admitted / evicted / queue_depth — the FleetServer
+# slot-pool lifecycle, fleet.py).
+_SCHEMA_V7_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     "umax", "dt_next",
     "poisson_iters", "poisson_residual",
@@ -81,14 +83,16 @@ _SCHEMA_V6_KEYS = (
     "snap_ring_bytes", "replayed_steps",
     "topology_epoch", "remesh_count", "remesh_ms",
     "fleet_members", "member_steps_per_s", "member_health",
+    "active_members", "occupancy", "admitted", "evicted",
+    "queue_depth",
     "phase_ms",
 )
 
 
-def test_metrics_schema_v6_key_set_pinned():
+def test_metrics_schema_v7_key_set_pinned():
     from cup2d_tpu.profiling import METRICS_SCHEMA_VERSION
-    assert METRICS_SCHEMA_VERSION == 6
-    assert METRICS_KEYS == _SCHEMA_V6_KEYS
+    assert METRICS_SCHEMA_VERSION == 7
+    assert METRICS_KEYS == _SCHEMA_V7_KEYS
 
 
 def test_metrics_schema_stable_uniform_amr_bench():
