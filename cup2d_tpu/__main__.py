@@ -59,6 +59,21 @@ hang); the in-place runtime re-init (launch.reinit_distributed) is
 library-level, pending a working multi-process runtime to validate
 against (ROADMAP).
 
+CASE CATALOG (cases.py + bc.py, ISSUE 12): ``-case cavity|channel|
+cylinder`` runs a named validation workload instead of parsing a
+reference config — the case supplies its own SimConfig, per-face
+BCTable and initial/obstacle state (``cavity``: lid-driven cavity,
+four no-slip walls + moving lid, obstacle-free, also fleet-servable
+via ``-fleet B``; ``channel``: Dirichlet inflow / convective outflow
+past a fixed cylinder; ``cylinder``: the legacy towed-disk free-slip
+case). ``-level N`` overrides the case's validation resolution. The
+per-face boundary-condition engine behind it (bc.py BCTable:
+free_slip | no_slip(u_wall) | dirichlet_inflow | convective_outflow
+per face) is a uniform-family feature; the AMR/forest tier and the
+Pallas megakernel tier refuse non-free-slip tables loudly at
+construction and the default table is bit-identical to the legacy
+free-slip/Neumann box.
+
 The run loop is SUPERVISED (resilience.py): every step's health verdict
 rides the diagnostics the step already pulls, a bad step walks the
 rewind/escalate/disk-restore/abort ladder, SIGTERM checkpoints at the
@@ -107,7 +122,11 @@ def main(argv=None) -> int:
     enable_compilation_cache()
     argv = sys.argv[1:] if argv is None else argv
     p = CommandlineParser(argv)
-    cfg = SimConfig.from_argv(argv)
+    case_name = p("case").asString() if p.has("case") else None
+    # a -case run gets its SimConfig from the catalog (cases.py), so
+    # the reference flag set (-bpdx/-tend/...) is not required on the
+    # command line; the case branch below sets cfg = sim.cfg
+    cfg = None if case_name is not None else SimConfig.from_argv(argv)
     fleet_n = p("fleet").asInt() if p.has("fleet") else 0
     serve_n = p("serve").asInt() if p.has("serve") else 0
     if serve_n and not fleet_n:
@@ -119,7 +138,8 @@ def main(argv=None) -> int:
               "<output>/sessions/<client>), not from a whole-fleet "
               "-restart", file=sys.stderr)
         return 2
-    uniform = fleet_n > 0 or p.has("level") or cfg.level_max <= 1
+    uniform = (fleet_n > 0 or p.has("level") or case_name is not None
+               or cfg.level_max <= 1)
     outdir = p("output").asString() if p.has("output") else "."
     ckpt_every = p("checkpointEvery").asInt() if p.has("checkpointEvery") \
         else 0
@@ -167,7 +187,45 @@ def main(argv=None) -> int:
               "(nothing to re-mesh onto otherwise)", file=sys.stderr)
         return 2
 
-    if fleet_n:
+    if case_name is not None:
+        # validation-case catalog (cases.py): the case supplies its own
+        # SimConfig + BCTable + initial/obstacle state; -level overrides
+        # the validation resolution, -fleet serves fleet-capable cases
+        from .cases import REGISTRY, make_sim
+        spec = REGISTRY.get(case_name)
+        if spec is None:
+            names = ", ".join(c for c in REGISTRY)
+            print(f"cup2d_tpu: unknown -case {case_name!r} "
+                  f"(catalog: {names})", file=sys.stderr)
+            return 2
+        kw = {}
+        if p.has("level"):
+            kw["level"] = p("level").asInt()
+        if fleet_n:
+            if not spec.fleet_ok:
+                print(f"cup2d_tpu: -case {case_name} does not ride the "
+                      "fleet slot pool (obstacle cases are solo-driver "
+                      "only)", file=sys.stderr)
+                return 2
+            kw["members"] = fleet_n
+        if mesh is not None:
+            if case_name != "cavity":
+                print(f"cup2d_tpu: -case {case_name} does not combine "
+                      "with -mesh (the sharded path is obstacle-free "
+                      "only)", file=sys.stderr)
+                return 2
+            kw["mesh"] = mesh
+        sim = make_sim(case_name, **kw)
+        cfg = sim.cfg   # the case's config drives dt/dump/end-time below
+        # -tend/-tdump still override the case's schedule (smoke runs
+        # want short horizons without forking the catalog); the grid
+        # and operators are already built from cfg, so only the
+        # schedule fields may be overridden post hoc
+        if p.has("tend"):
+            cfg.end_time = p("tend").asDouble()
+        if p.has("tdump"):
+            cfg.dump_time = p("tdump").asDouble()
+    elif fleet_n:
         if cfg.shapes:
             print("cup2d_tpu: -fleet supports obstacle-free uniform "
                   "runs only (shapes given)", file=sys.stderr)

@@ -144,8 +144,25 @@ class AMRSim(ShapeHostMixin):
     """Adaptive flow solver on the block forest, with or without
     immersed obstacles (the reference's only mode is 'with')."""
 
-    def __init__(self, cfg: SimConfig, shapes: Optional[Sequence] = None):
+    def __init__(self, cfg: SimConfig, shapes: Optional[Sequence] = None,
+                 bc=None):
         self.cfg = cfg
+        # Per-face BC tables (bc.py, ISSUE 12) are a UNIFORM-FAMILY
+        # contract: the forest's gather-table ghost exchange encodes
+        # boundary rows as linear sign-flip expressions (flux.py), with
+        # no slot for the inhomogeneous (moving-wall / inflow) or
+        # state-dependent (convective outflow) ghosts a non-default
+        # table needs — and the DCT-II spectral base solve assumes
+        # all-Neumann walls. Refuse loudly instead of silently running
+        # free-slip physics under a different label.
+        if bc is not None and not bc.is_free_slip:
+            raise ValueError(
+                f"AMRSim does not support non-free-slip BCTables "
+                f"({bc.token}): the forest gather-table ghost rows are "
+                "linear sign-flips (free-slip/Neumann only). Run this "
+                "case on the uniform family (UniformSim / Simulation / "
+                "ShardedUniformSim / FleetSim).")
+        self.case: Optional[str] = None  # case-registry tag (cases.py)
         # A/B env gates latched ONCE per sim, matching the
         # ShardedAMRSim._exchange pattern (ADVICE r5): a mid-run env
         # mutation must not silently flip the operator/preconditioner
@@ -1028,6 +1045,14 @@ class AMRSim(ShapeHostMixin):
         contract), so this is always the field dtype."""
         return {"float32": "f32", "float64": "f64"}.get(
             self.forest.dtype.name, self.forest.dtype.name)
+
+    @property
+    def bc_table(self) -> str:
+        """Per-face BC token string (telemetry schema v8). The forest
+        tier is free-slip-only by construction (see __init__'s
+        refusal), so this is the constant default token."""
+        from .bc import FREE_SLIP
+        return FREE_SLIP.token
 
     def _energy(self, v, hsq):
         """Kinetic energy of the masked ordered velocity — the

@@ -1,0 +1,352 @@
+"""Per-face boundary-condition tables (ISSUE 12).
+
+The reference (and rounds 1-11 of this reproduction) hard-code ONE box
+treatment: free-slip velocity walls (mirror ghosts, zero normal flow)
+paired with homogeneous-Neumann pressure. That single choice is baked
+into four separate layers — the ghost paint (``uniform.pad_vector``),
+the fused-BC stencil edge corrections (``ops/stencil._edge_ones``
+coefficients), the Poisson operator/smoother diagonals, and the Pallas
+megakernel's in-VMEM ghost synthesis. This module makes the table the
+single source of truth instead:
+
+* ``FaceBC`` — one face's treatment: ``free_slip`` | ``no_slip``
+  (optionally moving wall, ``u_wall``) | ``inflow`` (Dirichlet
+  velocity, uniform or parabolic profile) | ``outflow`` (convective
+  outflow, extrapolated with the local advection speed).
+* ``BCTable`` — four faces ``(x_lo, x_hi, y_lo, y_hi)``. Hashable and
+  comparable, so drivers can key executables and the FleetServer can
+  refuse mismatched admits.
+
+Discretization contracts (zeroth-order ghost convention, matching the
+legacy mirror paint — every ghost layer broadcasts from the edge line):
+
+velocity ghosts (``pad_vector_bc``)
+    free_slip   g = mirror: tangential copied, normal negated
+    no_slip     g = 2*u_wall - edge        (both components)
+    inflow      g = 2*u_in   - edge        (u_in possibly a profile)
+    outflow     g = edge + c*(edge - inner), c = clip(u_n*dt/h, 0, 1)
+                (local-advection-speed extrapolation; c=0 when no dt
+                is available, i.e. plain zeroth-order extrapolation)
+
+pressure (per-face sign ``s``, see ``pressure_signs``)
+    free_slip / no_slip / inflow -> homogeneous Neumann, s = +1
+        (ghost p = edge p: the wall-normal velocity is prescribed, so
+        the projection must not correct it)
+    outflow -> homogeneous Dirichlet at the mid-face, s = -1
+        (ghost p = -edge p => p = 0 on the face; the outflow face owns
+        the pressure level, removing the all-Neumann nullspace)
+
+divergence (undivided central form, ``ops/stencil.divergence_bc``)
+    free_slip / no_slip / inflow keep the legacy edge coefficients
+    (lo=+1, hi=-1); prescribed nonzero wall-NORMAL velocity adds an
+    affine constant on the edge line (-2*uw_n at lo, +2*uw_n at hi:
+    the ghost 2*uw - edge splits into the legacy mirror part plus the
+    constant — ``divergence_affine_bc``). outflow extrapolates
+    (ghost = edge) so the coefficient flips sign (lo=-1, hi=+1).
+
+Tables with any outflow face yield a NON-singular Poisson operator:
+``project_correct`` must then skip its mean-pressure removal
+(``BCTable.all_neumann`` gates it). All-Neumann non-free-slip tables
+(the lid-driven cavity) keep the legacy nullspace handling.
+
+The default table ``FREE_SLIP`` routes every consumer through the
+UNMODIFIED legacy code paths — bit-identity with rounds 1-11 is a
+tested contract, not an aspiration (tests/test_bc.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+
+_KINDS = ("free_slip", "no_slip", "inflow", "outflow")
+# (x_lo, x_hi, y_lo, y_hi): index of the wall-NORMAL velocity component
+# (vel layout [2, Ny, Nx]: component 0 = u, component 1 = v)
+_FACES = ("x_lo", "x_hi", "y_lo", "y_hi")
+
+
+class FaceBC(NamedTuple):
+    """One domain face's boundary treatment.
+
+    ``u_wall`` is the prescribed wall velocity (u, v) — the moving lid
+    of a cavity (no_slip) or the inflow velocity (inflow); ignored for
+    free_slip/outflow. ``profile`` shapes inflow along the face:
+    ``uniform`` (default) or ``parabolic`` (4*s*(1-s) Poiseuille
+    modulation, s in [0, 1] along the face tangent)."""
+
+    kind: str = "free_slip"
+    u_wall: Tuple[float, float] = (0.0, 0.0)
+    profile: str = "uniform"
+
+
+def free_slip() -> FaceBC:
+    return FaceBC("free_slip")
+
+
+def no_slip(u: float = 0.0, v: float = 0.0) -> FaceBC:
+    return FaceBC("no_slip", (float(u), float(v)))
+
+
+def dirichlet_inflow(u: float, v: float = 0.0,
+                     profile: str = "uniform") -> FaceBC:
+    if profile not in ("uniform", "parabolic"):
+        raise ValueError(
+            f"inflow profile {profile!r}: expected uniform|parabolic")
+    return FaceBC("inflow", (float(u), float(v)), profile)
+
+
+def convective_outflow() -> FaceBC:
+    return FaceBC("outflow")
+
+
+class BCTable(NamedTuple):
+    """Per-face boundary-condition table — the single source of truth
+    for domain-edge treatment across ghost paint, divergence, Poisson
+    operator/preconditioner and the projection correction."""
+
+    x_lo: FaceBC = FaceBC()
+    x_hi: FaceBC = FaceBC()
+    y_lo: FaceBC = FaceBC()
+    y_hi: FaceBC = FaceBC()
+
+    @staticmethod
+    def default() -> "BCTable":
+        return FREE_SLIP
+
+    def validate(self) -> "BCTable":
+        for name, f in zip(_FACES, self):
+            if f.kind not in _KINDS:
+                raise ValueError(
+                    f"BCTable.{name}: unknown kind {f.kind!r} "
+                    f"(expected one of {_KINDS})")
+        return self
+
+    @property
+    def is_free_slip(self) -> bool:
+        """True when every face is plain free-slip — the legacy path
+        dispatch: consumers must then run the UNMODIFIED pre-BC-engine
+        code, bit-identically."""
+        return all(f.kind == "free_slip" for f in self)
+
+    @property
+    def all_neumann(self) -> bool:
+        """True when no face is outflow: the pressure operator keeps
+        its constant nullspace and project_correct keeps the legacy
+        mean removal. Any outflow face pins the pressure level
+        (Dirichlet row) — mean removal must be skipped."""
+        return all(f.kind != "outflow" for f in self)
+
+    @property
+    def token(self) -> str:
+        """Compact per-face token string for telemetry (schema v8
+        ``bc_table``), order x_lo,x_hi,y_lo,y_hi — e.g. the legacy box
+        is ``fs,fs,fs,fs``, the lid-driven cavity
+        ``ns,ns,ns,ns(1,0)``."""
+        short = {"free_slip": "fs", "no_slip": "ns",
+                 "inflow": "in", "outflow": "out"}
+        toks = []
+        for f in self:
+            t = short[f.kind]
+            if f.kind in ("no_slip", "inflow") and any(f.u_wall):
+                u, v = f.u_wall
+                t += f"({u:g},{v:g})"
+            if f.kind == "inflow" and f.profile != "uniform":
+                t += f"[{f.profile}]"
+            toks.append(t)
+        return ",".join(toks)
+
+
+FREE_SLIP = BCTable()
+
+
+# ---------------------------------------------------------------------------
+# derived per-face coefficients for the operator tier (ops/stencil.*_bc)
+# ---------------------------------------------------------------------------
+
+def pressure_signs(bc: BCTable) -> Tuple[float, float, float, float]:
+    """Per-face pressure-ghost sign (x_lo, x_hi, y_lo, y_hi):
+    +1 homogeneous Neumann (ghost = edge) for prescribed-velocity
+    faces, -1 homogeneous Dirichlet (ghost = -edge, p=0 mid-face) for
+    convective outflow. Feeds laplacian5_bc edge indicators, the
+    smoother diagonal and the gradient edge coefficients."""
+    return tuple(-1.0 if f.kind == "outflow" else 1.0 for f in bc)
+
+
+def divergence_coeffs(bc: BCTable) -> Tuple[float, float, float, float]:
+    """Per-face edge coefficient of the wall-NORMAL velocity in the
+    undivided central divergence (x_lo, x_hi, y_lo, y_hi). Mirror and
+    2*uw-edge ghosts keep the legacy (+1, -1) pattern; extrapolated
+    outflow ghosts flip it."""
+    lo = {True: -1.0, False: 1.0}
+    return (lo[bc.x_lo.kind == "outflow"],
+            -lo[bc.x_hi.kind == "outflow"],
+            lo[bc.y_lo.kind == "outflow"],
+            -lo[bc.y_hi.kind == "outflow"])
+
+
+def _profile_1d(face: FaceBC, n: int, dtype):
+    """Inflow profile modulation along the face tangent: 1.0 (uniform)
+    or 4*s*(1-s) at cell centers, s = (i+0.5)/n."""
+    if face.kind != "inflow" or face.profile == "uniform":
+        return None
+    s = (jnp.arange(n, dtype=dtype) + 0.5) / n
+    return 4.0 * s * (1.0 - s)
+
+
+def divergence_affine_bc(bc: BCTable, ny: int, nx: int, dtype):
+    """Constant (state-independent) edge-line contribution of prescribed
+    nonzero wall-NORMAL velocities to the undivided divergence:
+    -2*uw_n on each lo edge line, +2*uw_n on each hi edge line
+    (no_slip / inflow faces only; the ghost 2*uw_n - edge minus the
+    mirror ghost -edge differs by exactly 2*uw_n). Returns None when
+    every term vanishes — notably the lid-driven cavity, whose walls
+    move only TANGENTIALLY, so its divergence is identical to
+    free-slip."""
+    out = None
+    # (face, normal component index, is_hi, axis): x faces -> u (0),
+    # y faces -> v (1)
+    specs = ((bc.x_lo, 0, False, "x"), (bc.x_hi, 0, True, "x"),
+             (bc.y_lo, 1, False, "y"), (bc.y_hi, 1, True, "y"))
+    for face, comp, is_hi, axis in specs:
+        if face.kind not in ("no_slip", "inflow"):
+            continue
+        uw_n = face.u_wall[comp]
+        if uw_n == 0.0:
+            continue
+        n_tan = ny if axis == "x" else nx
+        prof = _profile_1d(face, n_tan, dtype)
+        amp = (2.0 if is_hi else -2.0) * uw_n
+        line = jnp.full((n_tan,), amp, dtype=dtype) if prof is None \
+            else amp * prof
+        field = jnp.zeros((ny, nx), dtype=dtype)
+        if axis == "x":
+            col = nx - 1 if is_hi else 0
+            field = field.at[:, col].set(line)
+        else:
+            row = ny - 1 if is_hi else 0
+            field = field.at[row, :].set(line)
+        out = field if out is None else out + field
+    return out
+
+
+# ---------------------------------------------------------------------------
+# velocity ghost paint
+# ---------------------------------------------------------------------------
+
+def _face_wall(face: FaceBC, n_tan: int, dtype, along_rows: bool):
+    """Prescribed wall velocity (u, v) for a no_slip/inflow face as a
+    pair of broadcastable arrays/scalars over the face line.
+    ``along_rows``: the face tangent runs along rows (x faces, length
+    ny(+ghosts)); else along columns (y faces, length nx)."""
+    prof = _profile_1d(face, n_tan, dtype)
+    uw = []
+    for comp in range(2):
+        val = face.u_wall[comp]
+        if prof is None or val == 0.0:
+            uw.append(val)
+        else:
+            line = val * prof
+            uw.append(line[:, None] if along_rows else line[None, :])
+    return uw
+
+
+def _x_face_wall_padded(face: FaceBC, ny: int, g: int, dtype):
+    """x-face wall velocity evaluated over the PADDED row range
+    (ny + 2g): the x strips paint full rows so corners compose with
+    the already-painted y ghosts. Profile coordinates are clamped to
+    the face (s in [0,1]), so a parabolic profile closes to 0 at the
+    wall corners."""
+    if face.kind not in ("no_slip", "inflow"):
+        return (0.0, 0.0)
+    if face.profile == "uniform" or face.kind == "no_slip":
+        return face.u_wall
+    s = (jnp.arange(ny + 2 * g, dtype=dtype) - g + 0.5) / ny
+    s = jnp.clip(s, 0.0, 1.0)
+    prof = (4.0 * s * (1.0 - s))[:, None]
+    return tuple(v * prof if v != 0.0 else 0.0 for v in face.u_wall)
+
+
+def pad_vector_bc(v: jnp.ndarray, g: int, bc: BCTable, h: float,
+                  dt=None) -> jnp.ndarray:
+    """Per-face-table generalization of ``uniform.pad_vector``:
+    zero-pad by ``g`` ghost layers, then paint each face per its
+    ``FaceBC`` (kinds documented in the module docstring). Every ghost
+    layer broadcasts from one painted line — the legacy zeroth-order
+    mirror convention. y faces paint interior columns first; the x
+    strips then read the y-padded edge columns so corner ghosts
+    compose both faces' treatments, exactly like the legacy paint.
+
+    ``dt`` feeds the convective-outflow extrapolation speed
+    c = clip(u_n*dt/h, 0, 1); ``dt=None`` (diagnostics like the
+    vorticity paint) degrades to plain zeroth-order extrapolation
+    (c = 0). Free-slip tables dispatch to the legacy ``pad_vector``
+    verbatim (bit-identity)."""
+    if bc.is_free_slip:
+        from .uniform import pad_vector
+        return pad_vector(v, g)
+    ny, nx = v.shape[-2], v.shape[-1]
+    pad = [(0, 0)] * (v.ndim - 2) + [(g, g), (g, g)]
+    out = jnp.pad(v, pad)
+
+    def ghost(face, edge_u, edge_v, inner_u, inner_v, normal_comp,
+              outward_sign, uw):
+        # one painted line per component, broadcast over the g layers
+        if face.kind == "free_slip":
+            return ((-edge_u, edge_v) if normal_comp == 0
+                    else (edge_u, -edge_v))
+        if face.kind in ("no_slip", "inflow"):
+            return (2.0 * uw[0] - edge_u, 2.0 * uw[1] - edge_v)
+        # convective outflow: extrapolate with the local advection speed
+        edge_n = edge_u if normal_comp == 0 else edge_v
+        if dt is None:
+            c = 0.0
+        else:
+            c = jnp.clip(outward_sign * edge_n * dt / h, 0.0, 1.0)
+        return (edge_u + c * (edge_u - inner_u),
+                edge_v + c * (edge_v - inner_v))
+
+    # y faces first, interior columns (normal component = v = index 1)
+    f = bc.y_lo
+    uw = _face_wall(f, nx, v.dtype, along_rows=False) \
+        if f.kind in ("no_slip", "inflow") else (0.0, 0.0)
+    gu, gv = ghost(f, v[..., 0:1, :1, :], v[..., 1:2, :1, :],
+                   v[..., 0:1, 1:2, :], v[..., 1:2, 1:2, :], 1, -1.0, uw)
+    out = out.at[..., 0:1, :g, g:-g].set(
+        jnp.broadcast_to(gu, gu.shape[:-2] + (g, nx)))
+    out = out.at[..., 1:2, :g, g:-g].set(
+        jnp.broadcast_to(gv, gv.shape[:-2] + (g, nx)))
+    f = bc.y_hi
+    uw = _face_wall(f, nx, v.dtype, along_rows=False) \
+        if f.kind in ("no_slip", "inflow") else (0.0, 0.0)
+    gu, gv = ghost(f, v[..., 0:1, -1:, :], v[..., 1:2, -1:, :],
+                   v[..., 0:1, -2:-1, :], v[..., 1:2, -2:-1, :], 1, 1.0,
+                   uw)
+    out = out.at[..., 0:1, -g:, g:-g].set(
+        jnp.broadcast_to(gu, gu.shape[:-2] + (g, nx)))
+    out = out.at[..., 1:2, -g:, g:-g].set(
+        jnp.broadcast_to(gv, gv.shape[:-2] + (g, nx)))
+
+    # x faces over FULL rows, reading the y-painted columns so corners
+    # compose (normal component = u = index 0)
+    nyp = ny + 2 * g
+    f = bc.x_lo
+    uw = _x_face_wall_padded(f, ny, g, v.dtype)
+    gu, gv = ghost(f, out[..., 0:1, :, g:g + 1], out[..., 1:2, :, g:g + 1],
+                   out[..., 0:1, :, g + 1:g + 2],
+                   out[..., 1:2, :, g + 1:g + 2], 0, -1.0, uw)
+    out = out.at[..., 0:1, :, :g].set(
+        jnp.broadcast_to(gu, gu.shape[:-2] + (nyp, g)))
+    out = out.at[..., 1:2, :, :g].set(
+        jnp.broadcast_to(gv, gv.shape[:-2] + (nyp, g)))
+    f = bc.x_hi
+    uw = _x_face_wall_padded(f, ny, g, v.dtype)
+    gu, gv = ghost(f, out[..., 0:1, :, -g - 1:-g],
+                   out[..., 1:2, :, -g - 1:-g],
+                   out[..., 0:1, :, -g - 2:-g - 1],
+                   out[..., 1:2, :, -g - 2:-g - 1], 0, 1.0, uw)
+    out = out.at[..., 0:1, :, -g:].set(
+        jnp.broadcast_to(gu, gu.shape[:-2] + (nyp, g)))
+    out = out.at[..., 1:2, :, -g:].set(
+        jnp.broadcast_to(gv, gv.shape[:-2] + (nyp, g)))
+    return out

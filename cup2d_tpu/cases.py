@@ -1,0 +1,182 @@
+"""Validation-case catalog (ISSUE 12): named, runnable, serveable
+workloads built on the per-face BC engine (bc.py).
+
+Each case bundles the THREE things that define a workload — a
+SimConfig, a BCTable and the initial/obstacle state — behind one name,
+so the same case runs identically from the CLI (``-case cavity``), the
+validation probes (validation/cavity.py, validation/channel.py), tests
+and the fleet/serving layer. The registry is plain data + builder
+functions: adding a case is one ``CaseSpec`` entry, no solver changes.
+
+Catalog:
+
+``cavity``
+    Lid-driven cavity, THE canonical incompressible benchmark the
+    free-slip-only box could never express: unit box, four no-slip
+    walls, the y_hi lid translating at ``lid_u``. Obstacle-free
+    (UniformSim family — also fleet-servable: the table is all-Neumann
+    so the slot-pool solvers keep their mean-free contract). Validated
+    against the Ghia et al. (1982) Re=100 centerline profiles
+    (validation/cavity.py).
+
+``channel``
+    Channel flow past a FIXED cylinder: Dirichlet inflow at x_lo,
+    convective outflow at x_hi, free-slip side walls, a prescribed-
+    (0,0) disk in the stream, the whole domain impulsively started at
+    the inflow velocity. The true inflow-outflow configuration the
+    towed-cylinder case only approximates Galilean-ly. Validated by
+    shedding Strouhal number vs the Williamson (1989) Re=200 band
+    (validation/channel.py).
+
+``cylinder``
+    The legacy towed-cylinder drag/Strouhal case (free-slip box,
+    prescribed (-U, 0) disk) folded into the registry so
+    validation/cylinder.py runs through the same ``-case`` path it
+    validates.
+
+No environment reads here — cases parameterize through arguments only
+(tests/test_env_latch.py walks this package)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+from .bc import (BCTable, FREE_SLIP, convective_outflow,
+                 dirichlet_inflow, free_slip, no_slip)
+from .config import SimConfig
+
+
+@dataclass(frozen=True)
+class CaseSpec:
+    """One catalog entry: ``build(**kw)`` returns a ready-to-step
+    driver with ``sim.case`` set; ``default_level`` is the validation
+    resolution (CLI ``-level`` overrides); ``fleet_ok`` marks cases
+    whose obstacle-free state can ride the fleet slot pool."""
+
+    name: str
+    describe: str
+    build: Callable
+    default_level: int
+    fleet_ok: bool = False
+
+
+def cavity_table(lid_u: float = 1.0) -> BCTable:
+    """Four no-slip walls, the y_hi lid moving at (+lid_u, 0)."""
+    return BCTable(no_slip(), no_slip(), no_slip(), no_slip(lid_u, 0.0))
+
+
+def channel_table(u_in: float, profile: str = "uniform") -> BCTable:
+    """Dirichlet inflow at x_lo, convective outflow at x_hi, free-slip
+    side walls."""
+    return BCTable(dirichlet_inflow(u_in, profile=profile),
+                   convective_outflow(), free_slip(), free_slip())
+
+
+def build_cavity(level: Optional[int] = None, re: float = 100.0,
+                 lid_u: float = 1.0, dtype: str = "float32",
+                 mesh=None, members: int = 0, cfl: float = 0.4):
+    """Lid-driven cavity at Reynolds number ``re`` = lid_u * L / nu on
+    the unit box. Obstacle-free: UniformSim, or ShardedUniformSim over
+    ``mesh``, or a ``members``-slot FleetSim (every member the same
+    table — the pool contract)."""
+    lvl = 4 if level is None else level
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=1, level_start=0,
+                    extent=1.0, dtype=dtype, nu=lid_u / re, cfl=cfl,
+                    poisson_tol=1e-4, poisson_tol_rel=1e-3)
+    bc = cavity_table(lid_u)
+    if members > 0:
+        from .fleet import FleetSim
+        sim = FleetSim(cfg, level=lvl, members=members, mesh=mesh, bc=bc)
+    elif mesh is not None:
+        from .parallel.mesh import ShardedUniformSim
+        sim = ShardedUniformSim(cfg, mesh, level=lvl, bc=bc)
+    else:
+        from .uniform import UniformSim
+        sim = UniformSim(cfg, level=lvl, bc=bc)
+    sim.case = "cavity"
+    return sim
+
+
+def build_channel(level: Optional[int] = None, re: float = 200.0,
+                  u_in: float = 0.2, diameter: float = 0.1,
+                  dtype: str = "float32", profile: str = "uniform",
+                  xpos: float = 1.0):
+    """Channel past a fixed cylinder: 4x1 domain, impulsive start at
+    the inflow velocity, Re = u_in * diameter / nu. Returns a
+    Simulation (the obstacle path) — run ``sim.initialize()`` before
+    stepping, like any shaped case."""
+    import jax.numpy as jnp
+
+    from .models import DiskShape
+    from .sim import Simulation
+
+    lvl = 5 if level is None else level
+    cfg = SimConfig(bpdx=4, bpdy=1, level_max=1, level_start=0,
+                    extent=4.0, dtype=dtype, nu=u_in * diameter / re,
+                    lam=1e6, cfl=0.5, max_poisson_iterations=200,
+                    poisson_tol=1e-3, poisson_tol_rel=1e-2)
+    bc = channel_table(u_in, profile)
+    sim = Simulation(
+        cfg, shapes=[DiskShape(diameter / 2, xpos, 0.5,
+                               prescribed=(0.0, 0.0))],
+        level=lvl, bc=bc)
+    # impulsive start: the stream fills the domain at t=0 (the standard
+    # setup for the literature Strouhal band)
+    sim.state = sim.state._replace(
+        vel=sim.state.vel.at[0].set(jnp.asarray(u_in, sim.grid.dtype)))
+    sim.case = "channel"
+    return sim
+
+
+def build_cylinder(level: Optional[int] = None, D: float = 0.1,
+                   U: float = 0.2, nu: float = 5e-4, xpos: float = 3.2,
+                   bpdy: int = 1, dtype: str = "float32"):
+    """Legacy towed-cylinder case (validation/cylinder.py's _build):
+    free-slip box, prescribed (-U, 0) disk towed through still fluid —
+    the Galilean twin of ``channel`` in the closed box."""
+    from .models import DiskShape
+    from .sim import Simulation
+
+    lvl = 5 if level is None else level
+    cfg = SimConfig(bpdx=4, bpdy=bpdy, level_max=1, level_start=0,
+                    extent=4.0, dtype=dtype, nu=nu, lam=1e6, cfl=0.5,
+                    max_poisson_iterations=200, poisson_tol=1e-3,
+                    poisson_tol_rel=1e-2)
+    sim = Simulation(
+        cfg, shapes=[DiskShape(D / 2, xpos, 0.5 * bpdy,
+                               prescribed=(-U, 0.0))],
+        level=lvl, bc=FREE_SLIP)
+    sim.case = "cylinder"
+    return sim
+
+
+CASES: Tuple[CaseSpec, ...] = (
+    CaseSpec("cavity",
+             "lid-driven cavity (4x no-slip, moving lid), Re=100",
+             build_cavity, default_level=4, fleet_ok=True),
+    CaseSpec("channel",
+             "channel past a fixed cylinder (inflow/outflow), Re=200",
+             build_channel, default_level=5),
+    CaseSpec("cylinder",
+             "towed cylinder in the free-slip box (legacy validation)",
+             build_cylinder, default_level=5),
+)
+
+REGISTRY = {c.name: c for c in CASES}
+
+
+def case_names() -> Tuple[str, ...]:
+    return tuple(c.name for c in CASES)
+
+
+def make_sim(name: str, **kw):
+    """Build a named case's driver. Unknown names fail loudly with the
+    catalog listing (the CLI's ``-case`` error message)."""
+    spec = REGISTRY.get(name)
+    if spec is None:
+        listing = ", ".join(
+            f"{c.name} ({c.describe})" for c in CASES)
+        raise ValueError(
+            f"unknown case {name!r}; catalog: {listing}")
+    return spec.build(**kw)

@@ -127,7 +127,8 @@ class FleetSim:
 
     def __init__(self, cfg: SimConfig, level: Optional[int] = None,
                  members: int = 1, mesh=None, placement: str = "auto",
-                 member_cells_cap: int = 1 << 22, shaped: bool = False):
+                 member_cells_cap: int = 1 << 22, shaped: bool = False,
+                 bc=None):
         if members < 1:
             raise ValueError(f"need members >= 1, got {members}")
         self.cfg = cfg
@@ -161,7 +162,11 @@ class FleetSim:
         # spmd_safe only where spatial axes are actually sharded: the
         # member-parallel layout keeps every member's stencil axes
         # whole on one device, so the fast zero-shift form is safe
-        self.grid = UniformGrid(cfg, level, spmd_safe=(placement == "spatial"))
+        # bc: the pool-wide per-face BCTable (bc.py) — every member of
+        # a fleet shares ONE table (the slot-pool executable bakes the
+        # edge treatment in; FleetServer._admit refuses mismatches)
+        self.grid = UniformGrid(cfg, level,
+                                spmd_safe=(placement == "spatial"), bc=bc)
         g = self.grid
         self.state = stack_states([g.zero_state()
                                    for _ in range(self.members)])
@@ -176,6 +181,7 @@ class FleetSim:
         self.active_mask = np.ones(self.members, dtype=bool)
         self._active = None
         self.shapes: list = []    # obstacle-free by construction
+        self.case: Optional[str] = None  # case-registry tag (cases.py)
         self.timers = None
         self.force_log = None
         self._next_dt = None      # [B] device vector (end-state dt_next)
@@ -266,6 +272,11 @@ class FleetSim:
         """Hot-loop storage precision (telemetry schema v6)."""
         return self.grid.prec_mode
 
+    @property
+    def bc_table(self) -> str:
+        """Pool-wide per-face BC token string (telemetry schema v8)."""
+        return self.grid.bc_table
+
     def _pressure_solve(self, rhs: jnp.ndarray, exact: bool):
         """Member-batched ``UniformGrid.pressure_solve``: same
         tolerances/refresh/stall policy and the same CUP2D_POIS solve
@@ -345,7 +356,11 @@ class FleetSim:
         else:
             vold = vel
             for c in (0.5, 1.0):
-                lab = pad_vector(vel, 3)
+                # grid-level BC dispatch (bc.py): the default table is
+                # the legacy pad_vector verbatim; per-face tables paint
+                # their ghosts member-batched (dt4 broadcasts the
+                # per-member outflow extrapolation speed)
+                lab = g.pad_vector_field(vel, 3, dt4)
                 rhs = advect_diffuse_rhs(lab, 3, h, g.cfg.nu, dt4)
                 vel = heun_substage(vold, c, rhs, ih2)
 
@@ -359,12 +374,11 @@ class FleetSim:
             alpha = jnp.where(state.chi > 0.5,
                               1.0 / (1.0 + g.cfg.lam * dt3), 1.0)
             vel = alpha[:, None] * vel + (1.0 - alpha)[:, None] * state.us
-            b = divergence_rhs_fused(vel, state.udef, state.chi, h, dt3,
-                                     g.spmd_safe)
+            b = g.poisson_rhs(vel, state.chi, state.udef, dt3)
         else:
-            b = (0.5 * h / dt3) * divergence_freeslip(vel, g.spmd_safe)
+            b = g.poisson_rhs(vel, None, None, dt3)
         div_linf = jnp.max(jnp.abs(b), axis=(-2, -1)) * (dt / (h * h))
-        b = b - laplacian5_neumann(state.pres, g.spmd_safe)
+        b = b - g.laplacian(state.pres)
         if active is not None:
             # zero the dead rows of the Poisson RHS: their initial
             # residual is 0 <= max(tol, tol_rel*0), so the
@@ -377,7 +391,8 @@ class FleetSim:
         vel, pres = project_correct(
             res.x, state.pres, vel, h, dt,
             spmd_safe=g.spmd_safe, mean_axes=(-2, -1),
-            tier=g.kernel_tier)
+            tier=g.kernel_tier,
+            remove_mean=g.bc.all_neumann, grad_signs=g._psigns)
         if active is not None:
             # freeze dead slots: state, diag and clock all read the
             # UNSTEPPED values (bit-exact slot preservation under
@@ -577,13 +592,20 @@ class FleetRequest:
     round-trip losslessly). The member is retired once its clock
     reaches ``t_end``; ``next_dt`` (optional) overrides the first
     step's dt (otherwise the checkpoint's chained dt, else a fresh CFL
-    dt from the admitted velocity)."""
+    dt from the admitted velocity).
+
+    ``bc`` (optional) declares the session's expected per-face
+    :class:`~cup2d_tpu.bc.BCTable`: the pool's slot executables bake
+    ONE table's edge treatment in, so admission refuses a mismatch
+    loudly instead of stepping the session under the wrong ghosts.
+    None means "whatever the pool runs" (back-compat)."""
     client_id: str
     state: Optional[FlowState] = None
     checkpoint: Optional[str] = None
     t0: float = 0.0
     t_end: float = float("inf")
     next_dt: Optional[float] = None
+    bc: Optional[object] = None
 
 
 class FleetServer:
@@ -690,6 +712,15 @@ class FleetServer:
 
     def _admit(self, slot: int, req: FleetRequest) -> None:
         sim = self.sim
+        if req.bc is not None and req.bc != sim.grid.bc:
+            # slot-pool executables are BC-table-specific (the edge
+            # treatment is baked into the fused step): stepping this
+            # session would silently run it under the wrong ghosts
+            raise ValueError(
+                f"request {req.client_id!r}: session BCTable "
+                f"({req.bc.token}) does not match the pool's "
+                f"({sim.grid.bc.token}); submit it to a pool built "
+                "with that table")
         meta: dict = {}
         if req.checkpoint is not None:
             from .io import load_member_checkpoint

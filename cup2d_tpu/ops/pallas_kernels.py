@@ -236,6 +236,24 @@ def lab_tier_supported(dtype) -> bool:
     return HAVE_PALLAS and jnp.dtype(dtype) == jnp.float32
 
 
+def require_free_slip(bc) -> None:
+    """Kernel-tier routing guard for the per-face BC tables (bc.py):
+    every kernel in this module synthesizes FREE-SLIP wall ghosts in
+    VMEM from global row/col position — a moving-wall, inflow or
+    outflow face would be silently mirrored, computing wrong physics
+    with no diagnostic. Like the sharded-x-split case, the gap is
+    closed LOUDLY at construction; non-free-slip grids must stay on
+    the XLA chain (which routes ghosts through bc.pad_vector_bc and
+    the per-face stencil forms)."""
+    if bc is not None and not bc.is_free_slip:
+        raise ValueError(
+            "CUP2D_PALLAS=1 does not compose with a non-free-slip "
+            f"BCTable ({bc.token}): the fused kernel's in-VMEM wall-"
+            "ghost synthesis is free-slip-specific and would silently "
+            "mirror at a moving wall / inflow / outflow face. Unset "
+            "CUP2D_PALLAS for this case; it runs on the XLA tier.")
+
+
 def _substage_kernel(by, n, nx, cfac, ih2, has_vold, out_dtype,
                      facs_ref, vel_ref, *rest):
     """One Heun substage on one row strip of one batch member.

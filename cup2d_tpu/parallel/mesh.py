@@ -79,11 +79,12 @@ class ShardedUniformSim(UniformSim):
     hand-written communication code.
     """
 
-    def __init__(self, cfg: SimConfig, mesh: Mesh, level: Optional[int] = None):
+    def __init__(self, cfg: SimConfig, mesh: Mesh,
+                 level: Optional[int] = None, bc=None):
         # spmd_safe: the sharded axes go through the GSPMD partitioner,
         # which miscompiles the fast pad+slice zero-shift form
         # (ops/stencil._zshift)
-        super().__init__(cfg, level, spmd_safe=True)
+        super().__init__(cfg, level, spmd_safe=True, bc=bc)
         self._bind_mesh(mesh)
 
     def _bind_mesh(self, mesh: Mesh) -> None:

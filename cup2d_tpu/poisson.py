@@ -96,12 +96,26 @@ class MultigridPreconditioner:
     def __init__(self, ny: int, nx: int, dtype, nu1: int = 2,
                  nu2: int = 2, coarsest: int = 16, omega: float = 0.8,
                  cycle_dtype=None, spmd_safe: bool = False,
-                 mesh=None, overlap_levels: int = 1):
+                 mesh=None, overlap_levels: int = 1,
+                 edge_signs=None):
         self.shapes = []
         self.nu1 = nu1
         self.nu2 = nu2
         self.omega = omega
         self.spmd_safe = spmd_safe
+        # edge_signs: the BC table's per-face pressure-ghost signs
+        # (sx_lo, sx_hi, sy_lo, sy_hi) from bc.pressure_signs — the
+        # cycle's operator and Jacobi diagonal carry the same per-face
+        # rows at EVERY level (face kinds persist under coarsening, the
+        # wall diagonal stays in [-6, -2], never 0). None (default
+        # table) keeps the legacy all-Neumann forms verbatim. The
+        # overlapped sharded smoother (shard_halo) is free-slip-
+        # specific, so signed hierarchies keep the GSPMD sweeps — the
+        # Krylov/FAS outer loop drives the true per-face residual
+        # either way.
+        self.edge_signs = edge_signs
+        if edge_signs is not None:
+            overlap_levels = 0
         # mesh: opt-in comm/compute-overlapped smoothing for x-split
         # sharded fields (the FAS full-solver path, mesh.py): the
         # finest ``overlap_levels`` levels run their Jacobi sweeps
@@ -135,16 +149,28 @@ class MultigridPreconditioner:
         instead of an edge-mode pad, whose concatenate lowering
         materialized ~4.5 ms/step of bf16 strips inside the V-cycle at
         8192^2 (round-3 trace)."""
+        if self.edge_signs is not None:
+            from .ops.stencil import laplacian5_bc
+            sx_lo, sx_hi, sy_lo, sy_hi = self.edge_signs
+            return laplacian5_bc(p, sx_lo, sx_hi, sy_lo, sy_hi,
+                                 self.spmd_safe)
         from .ops.stencil import laplacian5_neumann
         return laplacian5_neumann(p, self.spmd_safe)
 
     def _inv_diag(self, lvl):
-        """1/(-4 + wall-side count), from broadcast 1-D iota indicators
-        (in-register, not DMA-staged constants — see stencil._edge_ones)."""
+        """1/(-4 + signed wall-side count), from broadcast 1-D iota
+        indicators (in-register, not DMA-staged constants — see
+        stencil._edge_ones). Per-face signs keep the diagonal in
+        [-6, -2]: always invertible."""
         from .ops.stencil import _edge_ones
         ny, nx = self.shapes[lvl]
-        ex = _edge_ones(nx, self.dtype)
-        ey = _edge_ones(ny, self.dtype)
+        if self.edge_signs is not None:
+            sx_lo, sx_hi, sy_lo, sy_hi = self.edge_signs
+            ex = _edge_ones(nx, self.dtype, lo=sx_lo, hi=sx_hi)
+            ey = _edge_ones(ny, self.dtype, lo=sy_lo, hi=sy_hi)
+        else:
+            ex = _edge_ones(nx, self.dtype)
+            ey = _edge_ones(ny, self.dtype)
         return 1.0 / (ey[:, None] + ex[None, :] - 4.0)
 
     def _smooth(self, e, r, lvl, n, from_zero=False):
@@ -758,7 +784,8 @@ def mg_solve(
 # ---------------------------------------------------------------------------
 
 def project_correct(x, pres_old, vel, h, dt, *, spmd_safe=False,
-                    mean_axes=None, tier="xla"):
+                    mean_axes=None, tier="xla", remove_mean=True,
+                    grad_signs=None):
     """Post-solve projection epilogue shared by the uniform and fleet
     drivers: ``pres = (x - mean x) + pres_old - mean pres_old`` and
     ``vel += -dt/(2h) * grad_neumann(pres) / h^2``.
@@ -773,12 +800,26 @@ def project_correct(x, pres_old, vel, h, dt, *, spmd_safe=False,
     update chain. The XLA branch is the historical expression verbatim,
     so tier="xla" callers are bit-identical to pre-PR-9 code.
 
+    ``remove_mean=False`` (per-face BC engine, bc.py): tables with an
+    outflow face carry a Dirichlet pressure row, the operator is
+    non-singular and the mean subtraction would shift the anchored
+    level — the epilogue then uses dp/pres as-is. ``grad_signs`` is
+    the table's (sx_lo, sx_hi, sy_lo, sy_hi) pressure-ghost sign tuple
+    feeding pressure_gradient_update_bc; None keeps the legacy
+    all-Neumann gradient verbatim. Non-default tables never reach the
+    Pallas tier (UniformGrid refuses at construction), so only the XLA
+    branch carries them.
+
     Returns (vel, pres).
     """
-    from .ops.stencil import pressure_gradient_update_fused
+    from .ops.stencil import (pressure_gradient_update_bc,
+                              pressure_gradient_update_fused)
 
     ih2 = 1.0 / (h * h)
-    if mean_axes is None:
+    if not remove_mean:
+        mx = jnp.zeros((), x.dtype)
+        mp = jnp.zeros((), x.dtype)
+    elif mean_axes is None:
         mx = jnp.mean(x)
         mp = jnp.mean(pres_old)
     else:
@@ -802,7 +843,15 @@ def project_correct(x, pres_old, vel, h, dt, *, spmd_safe=False,
             flat(mx), flat(mp), -0.5 * dtv * h, ih2)
         return velc.reshape(vel.shape), pres.reshape(x.shape)
     dt_b = dt[:, None, None, None] if jnp.ndim(dt) == 1 else dt
-    dp = x - mx
-    pres = dp + pres_old - mp
-    dv = pressure_gradient_update_fused(pres, h, dt_b, spmd_safe)
+    if not remove_mean:
+        pres = x + pres_old
+    else:
+        dp = x - mx
+        pres = dp + pres_old - mp
+    if grad_signs is None:
+        dv = pressure_gradient_update_fused(pres, h, dt_b, spmd_safe)
+    else:
+        sx_lo, sx_hi, sy_lo, sy_hi = grad_signs
+        dv = pressure_gradient_update_bc(pres, h, dt_b, sx_lo, sx_hi,
+                                         sy_lo, sy_hi, spmd_safe)
     return vel + dv * ih2, pres

@@ -345,7 +345,7 @@ class HostCounters:
 # always present; fields that do not apply to a path (AMR shape on a
 # uniform run, comm volume on a single device, counters when disabled)
 # are null — consumers key on names, never on presence.
-METRICS_SCHEMA_VERSION = 7
+METRICS_SCHEMA_VERSION = 8
 METRICS_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     # solver health + timestep state (the step's existing diag pull).
@@ -368,6 +368,13 @@ METRICS_KEYS = (
     # (.prec_mode — f32|f64|bf16), so a kernel-tier A/B run is
     # attributable from metrics.jsonl alone, like poisson_mode
     "kernel_tier", "prec_mode",
+    # boundary-condition attribution (schema v8, ISSUE 12): the
+    # driver's compact per-face BCTable token string (.bc_table — e.g.
+    # "fs,fs,fs,fs" legacy box, "ns,ns,ns,ns(1,0)" lid-driven cavity)
+    # and the case-registry tag (.case — cavity|channel|cylinder, null
+    # outside -case runs), so a record says WHICH physics scenario it
+    # measured, like poisson_mode says which solve path
+    "bc_table", "case",
     # fused on-device physics invariants (watchdog inputs)
     "energy", "div_linf",
     # AMR shape
@@ -536,9 +543,11 @@ class MetricsRecorder:
         if pm is None and sim is not None:
             pm = getattr(sim, "poisson_mode", None)
         rec["poisson_mode"] = str(pm) if pm is not None else None
-        # kernel-tier attribution (schema v6): same diag-then-driver
-        # pull as poisson_mode — host strings from constructor latches
-        for key in ("kernel_tier", "prec_mode"):
+        # kernel-tier attribution (schema v6) and BC/case attribution
+        # (schema v8): same diag-then-driver pull as poisson_mode —
+        # host strings from constructor latches (.bc_table is the
+        # table's token string, .case the case-registry tag)
+        for key in ("kernel_tier", "prec_mode", "bc_table", "case"):
             kv = diag.get(key)
             if kv is None and sim is not None:
                 kv = getattr(sim, key, None)

@@ -82,10 +82,13 @@ class Simulation(ShapeHostMixin):
     """Uniform-grid simulation with immersed obstacles."""
 
     def __init__(self, cfg: SimConfig, shapes: Optional[Sequence] = None,
-                 level: Optional[int] = None):
+                 level: Optional[int] = None, bc=None):
         self.cfg = cfg
-        self.grid = UniformGrid(cfg, level)
+        # bc: per-face BCTable (bc.py, ISSUE 12); None keeps the legacy
+        # free-slip box bit-identically (grid-level dispatch)
+        self.grid = UniformGrid(cfg, level, bc=bc)
         self.shapes = list(shapes) if shapes is not None else make_shapes(cfg)
+        self.case: Optional[str] = None  # case-registry tag (cases.py)
         self.time = 0.0
         self.step_count = 0
         self.state = self.grid.zero_state()
@@ -142,6 +145,11 @@ class Simulation(ShapeHostMixin):
     def prec_mode(self) -> str:
         """Hot-loop storage precision (telemetry schema v6)."""
         return self.grid.prec_mode
+
+    @property
+    def bc_table(self) -> str:
+        """Per-face BC token string (telemetry schema v8)."""
+        return self.grid.bc_table
 
     # ------------------------------------------------------------------
     # device: rasterization + chi + integrals (ongrid, main.cpp:4208-4630)

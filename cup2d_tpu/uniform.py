@@ -23,13 +23,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .bc import (
+    FREE_SLIP,
+    BCTable,
+    divergence_affine_bc,
+    divergence_coeffs,
+    pad_vector_bc,
+    pressure_signs,
+)
 from .config import SimConfig
 from .ops.stencil import (
     advect_diffuse_rhs,
+    divergence_bc,
     divergence_freeslip,
     divergence_rhs_fused,
     dt_from_umax,
     heun_substage,
+    laplacian5_bc,
     laplacian5_neumann,
     vorticity,
 )
@@ -130,12 +140,18 @@ class UniformGrid:
 
     def __init__(self, cfg: SimConfig, level: Optional[int] = None,
                  use_pallas: Optional[bool] = None,
-                 spmd_safe: bool = False):
+                 spmd_safe: bool = False,
+                 bc: Optional[BCTable] = None):
         # spmd_safe: the fused-BC stencil forms have a fast pad+slice
         # variant this image's GSPMD partitioner miscompiles on sharded
         # axes (see ops/stencil._zshift); sharded sims set True
         self.spmd_safe = spmd_safe
         self.cfg = cfg
+        # per-face boundary-condition table (bc.py, ISSUE 12): the
+        # single source of truth for the box-edge treatment. None/
+        # FREE_SLIP keeps every consumer on the UNMODIFIED legacy
+        # expressions (bit-identity pinned in tests/test_bc.py).
+        self.bc = (FREE_SLIP if bc is None else bc).validate()
         lvl = cfg.level_start if level is None else level
         if use_pallas is None:
             use_pallas = os.environ.get("CUP2D_PALLAS", "") == "1"
@@ -165,6 +181,11 @@ class UniformGrid:
                     "x-split (spmd_safe=True): the fused kernel's wall-"
                     "ghost synthesis is global, not shard-local. Unset "
                     "CUP2D_PALLAS for sharded runs.")
+            # same construction-time loudness for the per-face BC
+            # composition gap: the kernel's VMEM ghost synthesis is
+            # free-slip-specific (ops/pallas_kernels.require_free_slip)
+            from .ops.pallas_kernels import require_free_slip
+            require_free_slip(self.bc)
             ny = cfg.bpdy * cfg.bs << lvl
             nx = cfg.bpdx * cfg.bs << lvl
             from .ops.pallas_kernels import fused_tier_supported
@@ -206,6 +227,17 @@ class UniformGrid:
         self.h = cfg.h_at(lvl)
         self.dtype = jnp.dtype(cfg.dtype)
         self.p_inv = jnp.asarray(block_precond_matrix(cfg.bs), dtype=self.dtype)
+        # derived per-face operator coefficients (None on the default
+        # table => every consumer takes the legacy branch verbatim)
+        if self.bc.is_free_slip:
+            self._psigns = None
+            self._dcoeffs = None
+            self._div_affine = None
+        else:
+            self._psigns = pressure_signs(self.bc)
+            self._dcoeffs = divergence_coeffs(self.bc)
+            self._div_affine = divergence_affine_bc(
+                self.bc, self.ny, self.nx, self.dtype)
         # multigrid V-cycle preconditioner: O(1) Krylov iterations in N,
         # where the reference's single-level block-Jacobi (kept above for
         # the oracle/AMR paths) degrades linearly in N_1d/BS.
@@ -220,7 +252,8 @@ class UniformGrid:
         self.mg = MultigridPreconditioner(
             self.ny, self.nx, self.dtype, spmd_safe=spmd_safe,
             cycle_dtype=(self.dtype if self.solver_mode == "fas"
-                         else None))
+                         else None),
+            edge_signs=self._psigns)
         # f64 dot-product accumulation when fields are f32 AND x64 is
         # available (the Krylov scalars are precision-critical, SURVEY.md §7
         # hard part 5). Without x64, XLA's tree reduction keeps f32 error at
@@ -258,11 +291,51 @@ class UniformGrid:
     def compute_dt(self, vel: jnp.ndarray) -> jnp.ndarray:
         return self.dt_from_umax(jnp.max(jnp.abs(vel)))
 
-    # -- Poisson operator: undivided 5-point Laplacian w/ Neumann walls --
-    # (fused-BC form: zero-ghost shifts + rank-1 edge correction, no
-    # edge-mode pad concatenates — see ops/stencil.laplacian5_neumann)
+    # -- Poisson operator: undivided 5-point Laplacian with the table's
+    # per-face pressure rows (fused-BC form: zero-ghost shifts + rank-1
+    # edge correction — see ops/stencil.laplacian5_neumann/_bc). The
+    # default table takes the legacy all-Neumann expression verbatim.
     def laplacian(self, p: jnp.ndarray) -> jnp.ndarray:
-        return laplacian5_neumann(p, self.spmd_safe)
+        if self._psigns is None:
+            return laplacian5_neumann(p, self.spmd_safe)
+        sx_lo, sx_hi, sy_lo, sy_hi = self._psigns
+        return laplacian5_bc(p, sx_lo, sx_hi, sy_lo, sy_hi,
+                             self.spmd_safe)
+
+    # -- BC-aware ghost paint + divergence, shared with fleet.py's
+    # inlined member-batched step so the table dispatch cannot
+    # desynchronize between the solo and fleet paths --
+    def pad_vector_field(self, v: jnp.ndarray, g: int,
+                         dt=None) -> jnp.ndarray:
+        """Velocity ghost paint per the table; the default table is the
+        legacy free-slip mirror (``pad_vector``) unchanged. ``dt``
+        feeds the convective-outflow extrapolation speed (None degrades
+        outflow to zeroth-order — diagnostics only)."""
+        if self.bc.is_free_slip:
+            return pad_vector(v, g)
+        return pad_vector_bc(v, g, self.bc, self.h, dt)
+
+    def poisson_rhs(self, vel, chi, udef, dt) -> jnp.ndarray:
+        """(h/2dt)[div u* - chi div u_def] with the table's per-face
+        edge coefficients + the prescribed wall-normal-velocity affine
+        term (bc.divergence_affine_bc). ``chi=None`` drops the
+        obstacle term. Default table = the legacy fused expressions
+        bit-identically."""
+        h = self.h
+        if self._dcoeffs is None:
+            if chi is None:
+                return (0.5 * h / dt) * divergence_freeslip(
+                    vel, self.spmd_safe)
+            return divergence_rhs_fused(vel, udef, chi, h, dt,
+                                        self.spmd_safe)
+        fac = 0.5 * h / dt
+        b = fac * divergence_bc(vel, *self._dcoeffs, self.spmd_safe)
+        if self._div_affine is not None:
+            b = b + fac * self._div_affine
+        if chi is not None:
+            b = b - (fac * chi) * divergence_bc(
+                udef, *self._dcoeffs, self.spmd_safe)
+        return b
 
     def precond(self, r: jnp.ndarray) -> jnp.ndarray:
         return apply_block_precond(r, self.p_inv, self.cfg.bs)
@@ -291,6 +364,11 @@ class UniformGrid:
         return {"float32": "f32", "float64": "f64"}.get(
             self.dtype.name, self.dtype.name)
 
+    @property
+    def bc_table(self) -> str:
+        """Compact per-face BC token string (telemetry schema v8)."""
+        return self.bc.token
+
     def attach_mesh(self, mesh) -> None:
         """Give the MG hierarchy the device mesh so the FAS path runs
         its finest-level smoothing sweeps with the explicit overlapped
@@ -301,7 +379,8 @@ class UniformGrid:
             self.mg = MultigridPreconditioner(
                 self.ny, self.nx, self.dtype,
                 spmd_safe=self.spmd_safe, mesh=mesh,
-                cycle_dtype=self.dtype)
+                cycle_dtype=self.dtype,
+                edge_signs=self._psigns)
 
     def pressure_solve(self, rhs: jnp.ndarray, exact: bool = False):
         """Solve lap(dp) = rhs (undivided). ``exact`` reproduces the
@@ -354,7 +433,7 @@ class UniformGrid:
         ih2 = 1.0 / (self.h * self.h)
         vold = vel
         for c in (0.5, 1.0):
-            lab = pad_vector(vel, 3)
+            lab = self.pad_vector_field(vel, 3, dt)
             rhs = advect_diffuse_rhs(lab, 3, self.h, self.cfg.nu, dt)
             vel = heun_substage(vold, c, rhs, ih2)
         return vel
@@ -372,17 +451,18 @@ class UniformGrid:
         invariant, resilience.PhysicsWatchdog)."""
         h = self.h
         ih2 = 1.0 / (h * h)
-        if chi is None:
-            b = (0.5 * h / dt) * divergence_freeslip(vel, self.spmd_safe)
-        else:
-            b = divergence_rhs_fused(vel, udef, chi, h, dt, self.spmd_safe)
+        b = self.poisson_rhs(vel, chi, udef, dt)
         # |b| = (h/2dt) * |undivided div|; physical div = undivided/(2h)
         div_linf = jnp.max(jnp.abs(b)) * (dt / (h * h))
-        b = b - laplacian5_neumann(pres_old, self.spmd_safe)
+        b = b - self.laplacian(pres_old)
         res = self.pressure_solve(b, exact=exact_poisson)
+        # any-Dirichlet tables (outflow face) pin the pressure level:
+        # the operator is non-singular and the legacy mean removal
+        # would shift the anchored solution — skip it (bc.py docs)
         vel, pres = project_correct(
             res.x, pres_old, vel, h, dt,
-            spmd_safe=self.spmd_safe, tier=self._kernel_tier)
+            spmd_safe=self.spmd_safe, tier=self._kernel_tier,
+            remove_mean=self.bc.all_neumann, grad_signs=self._psigns)
         return vel, pres, res, div_linf
 
     def precond_cycles(self, res, exact):
@@ -462,20 +542,22 @@ class UniformGrid:
                            exact=exact_poisson)
 
     def vorticity_field(self, vel: jnp.ndarray) -> jnp.ndarray:
-        return vorticity(pad_vector(vel, 1), 1, self.h)
+        return vorticity(self.pad_vector_field(vel, 1), 1, self.h)
 
 
 class UniformSim:
     """Host-side driver: owns time/step counters, jits the device step."""
 
     def __init__(self, cfg: SimConfig, level: Optional[int] = None,
-                 spmd_safe: bool = False):
-        self.grid = UniformGrid(cfg, level, spmd_safe=spmd_safe)
+                 spmd_safe: bool = False,
+                 bc: Optional[BCTable] = None):
+        self.grid = UniformGrid(cfg, level, spmd_safe=spmd_safe, bc=bc)
         self.cfg = cfg
         self.state = self.grid.zero_state()
         self.time = 0.0
         self.step_count = 0
         self.shapes: list = []          # obstacle-free by construction
+        self.case: Optional[str] = None  # case-registry tag (cases.py)
         self.timers = None
         self.force_log = None
         self._next_dt = None            # cached end-state dt_next
@@ -509,6 +591,11 @@ class UniformSim:
     def prec_mode(self) -> str:
         """Hot-loop storage precision (telemetry schema v6)."""
         return self.grid.prec_mode
+
+    @property
+    def bc_table(self) -> str:
+        """Per-face BC token string (telemetry schema v8)."""
+        return self.grid.bc_table
 
     def step_once(self, dt: Optional[float] = None):
         """One supervised-loop-compatible step (the StepGuard driver

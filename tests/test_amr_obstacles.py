@@ -71,6 +71,12 @@ def test_disk_forest_matches_uniform():
     assert err < 1e-10, err
 
 
+@pytest.mark.slow   # ~23 s; duplicative tier-1 coverage: the canonical
+#                     golden (test_golden.py) pins the post-climb block
+#                     topology EXACTLY (n_blocks at every CHECK_STEP of
+#                     the 2-fish levelStart -> levelMax case), so a chi
+#                     tagging regression cannot pass tier-1 — this
+#                     drills the same climb in isolation on a disk
 def test_chi_tagging_refines_to_finest():
     """Initialization must refine every chi-support block to the finest
     level (the canonical case's levelStart -> levelMax climb,

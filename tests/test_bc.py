@@ -1,0 +1,427 @@
+"""Boundary-condition engine tests (cup2d_tpu/bc.py, ISSUE 12).
+
+Four contracts:
+
+- Ghost-paint correctness: ``pad_vector_bc`` matches hand-rolled
+  transcriptions of the per-kind ghost formulas (mirror / 2*uw - edge /
+  convective extrapolation), including the corner composition and the
+  clamped parabolic inflow profile.
+- Operator-tier correctness: the per-face fused-BC stencil forms
+  (laplacian5_bc / divergence_bc / pressure_gradient_update_bc) match
+  explicit ghost-padded references, and collapse to the legacy
+  free-slip/Neumann forms at the legacy coefficients.
+- Default-table BIT-identity: every driver built with ``bc=FREE_SLIP``
+  (or no bc at all) produces bitwise the trajectories of rounds 1-11 —
+  the table is a dispatch, not a reimplementation.
+- Loud refusal at every tier that cannot honor a table: the Pallas
+  megakernel (in-VMEM mirror synthesis), the AMR forest (sign-flip
+  gather rows) and the FleetServer admit path (pool executables are
+  table-specific).
+
+Plus the standing physics sanity: a coarse lid-driven cavity develops
+the lid-following shear layer, and a uniform inflow/outflow channel
+transports the exact plug flow unchanged (the Dirichlet-pressure
+machinery's null test). The full Ghia et al. comparison is
+@pytest.mark.slow (validation/cavity.py runs it standalone too).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cup2d_tpu.bc import (BCTable, FREE_SLIP, convective_outflow,
+                          dirichlet_inflow, divergence_affine_bc,
+                          divergence_coeffs, free_slip, no_slip,
+                          pad_vector_bc, pressure_signs)
+from cup2d_tpu.cases import cavity_table, channel_table, make_sim
+from cup2d_tpu.config import SimConfig
+from cup2d_tpu.ops.stencil import (divergence_bc, divergence_freeslip,
+                                   laplacian5_bc, laplacian5_neumann,
+                                   pressure_gradient_update_bc,
+                                   pressure_gradient_update_fused)
+from cup2d_tpu.uniform import (UniformGrid, UniformSim, pad_vector,
+                               taylor_green_state)
+
+
+def _cfg(**kw):
+    base = dict(bpdx=1, bpdy=1, level_max=1, level_start=0, extent=1.0,
+                nu=1e-3, cfl=0.4, lam=1e6, dtype="float64",
+                max_poisson_iterations=100)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _rand(shape, seed, dtype=jnp.float64):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# table semantics (token / flags / validation)
+# ---------------------------------------------------------------------------
+
+def test_table_tokens_flags_and_validation():
+    assert FREE_SLIP.token == "fs,fs,fs,fs"
+    assert FREE_SLIP.is_free_slip and FREE_SLIP.all_neumann
+
+    cav = cavity_table()
+    assert cav.token == "ns,ns,ns,ns(1,0)"
+    assert not cav.is_free_slip and cav.all_neumann
+
+    chan = channel_table(0.2)
+    assert chan.token == "in(0.2,0),out,fs,fs"
+    assert not chan.is_free_slip and not chan.all_neumann
+    par = channel_table(0.2, profile="parabolic")
+    assert par.token == "in(0.2,0)[parabolic],out,fs,fs"
+
+    # hashable + comparable: the FleetServer admit check and executable
+    # keying depend on value semantics
+    assert cavity_table() == cavity_table()
+    assert hash(cavity_table()) == hash(cavity_table())
+    assert cavity_table() != cavity_table(lid_u=2.0)
+
+    with pytest.raises(ValueError, match="unknown kind"):
+        BCTable(x_lo=free_slip()._replace(kind="periodic")).validate()
+    with pytest.raises(ValueError, match="uniform|parabolic"):
+        dirichlet_inflow(1.0, profile="plug")
+
+
+def test_derived_coefficients():
+    # signs: +1 Neumann everywhere except outflow (-1 Dirichlet)
+    assert pressure_signs(FREE_SLIP) == (1.0, 1.0, 1.0, 1.0)
+    assert pressure_signs(cavity_table()) == (1.0, 1.0, 1.0, 1.0)
+    assert pressure_signs(channel_table(0.2)) == (1.0, -1.0, 1.0, 1.0)
+
+    # divergence edge coefficients: legacy (+1, -1) except outflow flips
+    assert divergence_coeffs(FREE_SLIP) == (1.0, -1.0, 1.0, -1.0)
+    assert divergence_coeffs(channel_table(0.2)) == (1.0, 1.0, 1.0, -1.0)
+
+    # affine term: the cavity's walls move only TANGENTIALLY -> no
+    # divergence source at all (identical to free-slip)
+    assert divergence_affine_bc(cavity_table(), 8, 8, jnp.float64) is None
+    # a uniform inflow at x_lo sources -2*u_in on the first column
+    aff = divergence_affine_bc(channel_table(0.2), 4, 6, jnp.float64)
+    ref = np.zeros((4, 6))
+    ref[:, 0] = -2.0 * 0.2
+    np.testing.assert_array_equal(np.asarray(aff), ref)
+
+
+# ---------------------------------------------------------------------------
+# ghost paint vs hand-rolled edge stencils (all four kinds)
+# ---------------------------------------------------------------------------
+
+def test_pad_free_slip_table_dispatches_bitwise():
+    v = _rand((2, 6, 9), 0)
+    np.testing.assert_array_equal(np.asarray(pad_vector_bc(v, 3, FREE_SLIP, 0.1)),
+                                  np.asarray(pad_vector(v, 3)))
+
+
+def test_pad_no_slip_moving_lid_with_corners():
+    """All-no_slip cavity: ghost = 2*u_wall - edge for BOTH components
+    on every face; the x strips read the y-painted columns, so a corner
+    ghost composes both walls' formulas exactly like the legacy mirror
+    paint composes its reflections."""
+    g, ny, nx = 2, 5, 7
+    v = _rand((2, ny, nx), 1)
+    lid = (0.7, 0.0)
+    bc = BCTable(no_slip(), no_slip(), no_slip(), no_slip(*lid))
+    out = np.asarray(pad_vector_bc(v, g, bc, 0.1))
+    vn = np.asarray(v)
+
+    # interior untouched
+    np.testing.assert_array_equal(out[:, g:-g, g:-g], vn)
+    # y faces (interior columns): stationary floor, moving lid
+    for k in range(g):
+        np.testing.assert_allclose(out[:, k, g:-g], -vn[:, 0, :])
+        np.testing.assert_allclose(out[0, ny + g + k, g:-g],
+                                   2.0 * lid[0] - vn[0, -1, :])
+        np.testing.assert_allclose(out[1, ny + g + k, g:-g],
+                                   -vn[1, -1, :])
+    # x faces (FULL rows, reading the y-painted edge columns)
+    for k in range(g):
+        np.testing.assert_allclose(out[:, :, k], -out[:, :, g])
+        np.testing.assert_allclose(out[:, :, nx + g + k],
+                                   -out[:, :, nx + g - 1])
+    # spot-check one corner ghost explicitly: (lid ghost) then mirrored
+    # through the x_lo wall -> -(2*lid - edge)
+    np.testing.assert_allclose(out[0, ny + g, 0],
+                               -(2.0 * lid[0] - vn[0, -1, 0]))
+
+
+def test_pad_parabolic_inflow_profile_clamped():
+    g, ny, nx = 2, 8, 6
+    v = _rand((2, ny, nx), 2)
+    u_in = 0.4
+    bc = BCTable(dirichlet_inflow(u_in, profile="parabolic"),
+                 convective_outflow(), free_slip(), free_slip())
+    out = np.asarray(pad_vector_bc(v, g, bc, 0.1))
+
+    # the y faces are free-slip: v mirrored, u copied
+    edge_u_col = out[0, :, g]          # y-padded edge column
+    s = (np.arange(ny + 2 * g) - g + 0.5) / ny
+    s = np.clip(s, 0.0, 1.0)           # profile closes at the corners
+    prof = 4.0 * s * (1.0 - s)
+    for k in range(g):
+        np.testing.assert_allclose(out[0, :, k],
+                                   2.0 * u_in * prof - edge_u_col,
+                                   rtol=1e-12)
+        np.testing.assert_allclose(out[1, :, k], -out[1, :, g])
+
+
+def test_pad_convective_outflow_local_speed():
+    g, ny, nx = 2, 5, 6
+    v = _rand((2, ny, nx), 3)
+    h, dt = 0.1, 0.04
+    bc = BCTable(free_slip(), convective_outflow(), free_slip(),
+                 free_slip())
+    out = np.asarray(pad_vector_bc(v, g, bc, h, dt=dt))
+
+    edge = np.asarray(pad_vector_bc(v, g, bc, h, dt=dt))[:, :, nx + g - 1]
+    inner = out[:, :, nx + g - 2]
+    c = np.clip(out[0, :, nx + g - 1] * dt / h, 0.0, 1.0)
+    for k in range(g):
+        np.testing.assert_allclose(out[:, :, nx + g + k],
+                                   edge + c * (edge - inner), rtol=1e-12)
+    # dt=None (diagnostic paint) degrades to zeroth-order extrapolation
+    out0 = np.asarray(pad_vector_bc(v, g, bc, h))
+    for k in range(g):
+        np.testing.assert_allclose(out0[:, :, nx + g + k],
+                                   out0[:, :, nx + g - 1])
+
+
+# ---------------------------------------------------------------------------
+# fused-BC operator forms vs ghost-padded references
+# ---------------------------------------------------------------------------
+
+def _ref_lap(p, signs):
+    sx_lo, sx_hi, sy_lo, sy_hi = signs
+    ny, nx = p.shape
+    pe = np.zeros((ny + 2, nx + 2), p.dtype)
+    pe[1:-1, 1:-1] = p
+    pe[1:-1, 0] = sx_lo * p[:, 0]
+    pe[1:-1, -1] = sx_hi * p[:, -1]
+    pe[0, 1:-1] = sy_lo * p[0, :]
+    pe[-1, 1:-1] = sy_hi * p[-1, :]
+    return (pe[1:-1, 2:] + pe[1:-1, :-2] + pe[2:, 1:-1] + pe[:-2, 1:-1]
+            - 4.0 * p)
+
+
+def test_laplacian5_bc_vs_ghost_padded_reference():
+    p = np.asarray(_rand((7, 9), 4))
+    legacy = laplacian5_bc(jnp.asarray(p), 1.0, 1.0, 1.0, 1.0)
+    np.testing.assert_array_equal(np.asarray(legacy),
+                                  np.asarray(laplacian5_neumann(jnp.asarray(p))))
+    for signs in ((1.0, -1.0, 1.0, 1.0), (-1.0, -1.0, 1.0, -1.0)):
+        got = laplacian5_bc(jnp.asarray(p), *signs)
+        np.testing.assert_allclose(np.asarray(got), _ref_lap(p, signs),
+                                   rtol=1e-13)
+
+
+def test_divergence_bc_vs_reference_and_legacy():
+    v = _rand((2, 6, 8), 5)
+    legacy = divergence_bc(v, 1.0, -1.0, 1.0, -1.0)
+    np.testing.assert_array_equal(np.asarray(legacy),
+                                  np.asarray(divergence_freeslip(v)))
+
+    # outflow at x_hi: ghost u = edge u -> edge coefficient flips
+    got = np.asarray(divergence_bc(v, 1.0, 1.0, 1.0, -1.0))
+    u, w = np.asarray(v[0]), np.asarray(v[1])
+    ue = np.zeros((u.shape[0], u.shape[1] + 2))
+    ue[:, 1:-1] = u
+    ue[:, 0] = -u[:, 0]        # mirror ghost
+    ue[:, -1] = u[:, -1]       # extrapolated ghost
+    we = np.zeros((w.shape[0] + 2, w.shape[1]))
+    we[1:-1, :] = w
+    we[0, :] = -w[0, :]
+    we[-1, :] = -w[-1, :]
+    ref = (ue[:, 2:] - ue[:, :-2]) + (we[2:, :] - we[:-2, :])
+    np.testing.assert_allclose(got, ref, rtol=1e-13)
+
+
+def test_pressure_gradient_bc_vs_reference_and_legacy():
+    p = _rand((6, 8), 6)
+    h, dt = 0.1, 0.03
+    legacy = pressure_gradient_update_bc(p, h, dt, 1.0, 1.0, 1.0, 1.0)
+    np.testing.assert_array_equal(
+        np.asarray(legacy),
+        np.asarray(pressure_gradient_update_fused(p, h, dt)))
+
+    # Dirichlet x_hi: the gradient differences against the reflected
+    # ghost (-edge) instead of the copied one
+    got = np.asarray(pressure_gradient_update_bc(p, h, dt,
+                                                 1.0, -1.0, 1.0, 1.0))
+    pn = np.asarray(p)
+    signs = (1.0, -1.0, 1.0, 1.0)
+    ny, nx = pn.shape
+    pe = np.zeros((ny + 2, nx + 2))
+    pe[1:-1, 1:-1] = pn
+    pe[1:-1, 0] = signs[0] * pn[:, 0]
+    pe[1:-1, -1] = signs[1] * pn[:, -1]
+    pe[0, 1:-1] = signs[2] * pn[0, :]
+    pe[-1, 1:-1] = signs[3] * pn[-1, :]
+    pfac = -0.5 * dt * h
+    ref = pfac * np.stack([pe[1:-1, 2:] - pe[1:-1, :-2],
+                           pe[2:, 1:-1] - pe[:-2, 1:-1]])
+    np.testing.assert_allclose(got, ref, rtol=1e-13)
+
+
+# ---------------------------------------------------------------------------
+# default-table BIT-identity on every driver (the dispatch contract)
+# ---------------------------------------------------------------------------
+
+def _run_steps(sim, n=4):
+    for _ in range(n):
+        sim.step_once()
+    return np.asarray(sim.state.vel), np.asarray(sim.state.pres)
+
+
+def test_default_table_bit_identical_uniform():
+    a = UniformSim(_cfg(), level=2)
+    b = UniformSim(_cfg(), level=2, bc=FREE_SLIP)
+    # distinct state objects: the stepping jits donate their buffers
+    a.state = taylor_green_state(a.grid)
+    b.state = taylor_green_state(b.grid)
+    va, pa = _run_steps(a)
+    vb, pb = _run_steps(b)
+    np.testing.assert_array_equal(va, vb)
+    np.testing.assert_array_equal(pa, pb)
+    assert a.bc_table == b.bc_table == "fs,fs,fs,fs"
+
+
+@pytest.mark.slow
+def test_default_table_bit_identical_shaped():
+    # slow: ~12 s of shaped-step compiles. The bit-identity CONTRACT is
+    # pinned tier-1 on UniformSim above — every driver routes the
+    # default-table dispatch through the same grid-level is_free_slip
+    # selection, so this drills the obstacle-step COMPOSITION of that
+    # already-pinned dispatch (PR-6 duplicative-heavyweight precedent).
+    from cup2d_tpu.models import DiskShape
+    from cup2d_tpu.sim import Simulation
+
+    def build(**kw):
+        s = Simulation(_cfg(), shapes=[DiskShape(0.12, 0.4, 0.5,
+                                                 prescribed=(0.2, 0.0))],
+                       level=3, **kw)
+        s.initialize()
+        return s
+
+    va, pa = _run_steps(build(), 3)
+    vb, pb = _run_steps(build(bc=FREE_SLIP), 3)
+    np.testing.assert_array_equal(va, vb)
+    np.testing.assert_array_equal(pa, pb)
+
+
+@pytest.mark.slow
+def test_default_table_bit_identical_sharded():
+    # slow: ~37 s of 8-device sharded jit compiles (steps are ~free).
+    # Same rationale as the shaped twin: the dispatch contract is
+    # tier-1 on UniformSim, and the sharded step itself is pinned by
+    # the tier-1 sharded==single equalities in test_mesh.py.
+    from cup2d_tpu.parallel.mesh import ShardedUniformSim, make_mesh
+    mesh = make_mesh(8)
+    a = ShardedUniformSim(_cfg(), mesh, level=3)
+    b = ShardedUniformSim(_cfg(), mesh, level=3, bc=FREE_SLIP)
+    a.set_state(taylor_green_state(a.grid))
+    b.set_state(taylor_green_state(b.grid))
+    va, pa = _run_steps(a, 3)
+    vb, pb = _run_steps(b, 3)
+    np.testing.assert_array_equal(va, vb)
+    np.testing.assert_array_equal(pa, pb)
+
+
+# ---------------------------------------------------------------------------
+# loud refusals: Pallas tier, AMR forest, fleet admit
+# ---------------------------------------------------------------------------
+
+def test_pallas_tier_refuses_non_free_slip_table(monkeypatch):
+    """The megakernel synthesizes MIRROR ghosts in VMEM — running it
+    under any other table would silently compute wrong walls. Refusal
+    is at grid construction, same contract as the sharded-x-split
+    refusal (test_megakernel.py)."""
+    monkeypatch.setenv("CUP2D_PALLAS", "1")
+    monkeypatch.delenv("CUP2D_PREC", raising=False)
+    cfg = _cfg(dtype="float32")
+    with pytest.raises(ValueError, match="non-free-slip"):
+        UniformGrid(cfg, level=2, bc=cavity_table())
+    # the default table still composes with the tier request
+    UniformGrid(cfg, level=2, bc=FREE_SLIP)
+
+
+def test_amr_refuses_non_free_slip_table():
+    from cup2d_tpu.amr import AMRSim
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=3, level_start=1,
+                    extent=1.0, dtype="float64", nu=1e-3, lam=1e5,
+                    rtol=0.5, ctol=0.05, max_poisson_iterations=40,
+                    poisson_tol=1e-4, poisson_tol_rel=1e-3)
+    with pytest.raises(ValueError, match="non-free-slip"):
+        AMRSim(cfg, shapes=[], bc=cavity_table())
+
+
+def test_fleet_server_refuses_bc_mismatched_admit():
+    from cup2d_tpu.fleet import FleetRequest, FleetServer, FleetSim
+    sim = FleetSim(_cfg(), level=2, members=2, bc=cavity_table())
+    sim.step_count = 20            # production regime (as in fleet tests)
+    server = FleetServer(sim)
+    st = sim.grid.zero_state()
+
+    # matching table admits; a session minted for the legacy box does not
+    server.submit(FleetRequest(client_id="ok", state=st,
+                               bc=cavity_table()))
+    assert server.step() is not None
+    server.submit(FleetRequest(client_id="bad", state=st, bc=FREE_SLIP))
+    with pytest.raises(ValueError, match="does not match the pool"):
+        server.step()
+
+
+# ---------------------------------------------------------------------------
+# physics sanity (tier-1 sized)
+# ---------------------------------------------------------------------------
+
+def test_cavity_coarse_develops_lid_shear():
+    """32^2 cavity, a few dozen steps: state stays finite, the top row
+    follows the lid, the bottom row barely moves, and the projection
+    keeps the discrete divergence near zero — the cheap standing proxy
+    for the @slow Ghia comparison."""
+    sim = make_sim("cavity", level=2, dtype="float64")
+    assert sim.case == "cavity" and sim.bc_table == "ns,ns,ns,ns(1,0)"
+    for _ in range(40):
+        sim.step_once()
+    vel = np.asarray(sim.state.vel)
+    assert np.all(np.isfinite(vel))
+    top = float(vel[0, -1, :].mean())
+    bottom = float(np.abs(vel[0, 0, :]).mean())
+    assert top > 0.3                      # lid-following shear layer
+    assert bottom < 0.1 * top
+    d = np.asarray(sim.grid.laplacian(sim.state.pres))  # operator runs
+    assert np.all(np.isfinite(d))
+
+
+def test_plug_flow_is_exact_through_inflow_outflow():
+    """Uniform u = u_in with inflow at x_lo and convective outflow at
+    x_hi is an EXACT steady solution: zero divergence, zero advective
+    residual, zero pressure. Any sign error in the Dirichlet pressure
+    rows, the flipped divergence coefficient or the affine inflow
+    source would break this immediately."""
+    u_in = 0.2
+    cfg = _cfg(bpdx=2, extent=2.0, nu=1e-3, cfl=0.3)
+    bc = channel_table(u_in)
+    sim = UniformSim(cfg, level=2, bc=bc)
+    st = sim.grid.zero_state()
+    sim.state = st._replace(
+        vel=st.vel.at[0].set(jnp.asarray(u_in, sim.grid.dtype)))
+    for _ in range(25):
+        sim.step_once()
+    vel = np.asarray(sim.state.vel)
+    np.testing.assert_allclose(vel[0], u_in, atol=1e-10)
+    np.testing.assert_allclose(vel[1], 0.0, atol=1e-10)
+
+
+@pytest.mark.slow
+def test_cavity_ghia_re100_within_2pct():
+    """The full acceptance run: Re=100 at 128^2 to quasi-steady state,
+    both centerline profiles within 2% of the lid speed vs Ghia et al.
+    (1982). Standalone: python -m validation.cavity."""
+    from validation.cavity import run
+    err_u, err_v = run(level=4, dtype="float64", t_end=30.0, quiet=True)
+    assert err_u <= 0.02
+    assert err_v <= 0.02

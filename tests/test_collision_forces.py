@@ -179,6 +179,12 @@ def test_pairwise_update_matches_unrolled_order():
                        rtol=1e-12, atol=1e-12)
 
 
+@pytest.mark.slow   # ~12 s; duplicative tier-1 coverage: the merged-
+#                     integral + fori_loop impulse path is pinned
+#                     bit-level by test_golden_collision.py and the
+#                     in-sim force plumbing by
+#                     test_towed_disk_forces_and_log — this is a
+#                     9-body endurance composition of the same path
 def test_many_disk_simulation_steps():
     """Nine free disks in a box: the many-body path (merged integrals +
     fori_loop impulses) compiles once and steps stably."""

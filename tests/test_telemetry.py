@@ -55,7 +55,7 @@ def _amr_sim():
 # schema stability (golden key set): every producer emits the SAME keys
 # ---------------------------------------------------------------------------
 
-# the LITERAL schema-v6 key set: METRICS_KEYS is the producers' truth,
+# the LITERAL schema-v8 key set: METRICS_KEYS is the producers' truth,
 # this tuple is the consumers' — any drift between them (a key renamed,
 # dropped, or added without bumping the schema) fails here on purpose.
 # v3 added the fleet-batching fields (fleet_members / member_steps_per_s
@@ -68,14 +68,18 @@ def _amr_sim():
 # latch — and prec_mode, the CUP2D_PREC storage-precision contract,
 # PR 9); v7 the continuous-batching serving gauges (active_members /
 # occupancy / admitted / evicted / queue_depth — the FleetServer
-# slot-pool lifecycle, fleet.py).
-_SCHEMA_V7_KEYS = (
+# slot-pool lifecycle, fleet.py); v8 the boundary-condition attribution
+# pair (bc_table — the driver's BCTable token, e.g. "fs,fs,fs,fs" —
+# and case, the case-registry tag or null for ad-hoc runs, bc.py +
+# cases.py).
+_SCHEMA_V8_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     "umax", "dt_next",
     "poisson_iters", "poisson_residual",
     "poisson_converged", "poisson_stalled",
     "poisson_mode", "precond_cycles",
     "kernel_tier", "prec_mode",
+    "bc_table", "case",
     "energy", "div_linf",
     "n_blocks", "blocks_per_level", "refines", "coarsens",
     "halo_real_bytes", "halo_padded_bytes",
@@ -89,12 +93,19 @@ _SCHEMA_V7_KEYS = (
 )
 
 
-def test_metrics_schema_v7_key_set_pinned():
+def test_metrics_schema_v8_key_set_pinned():
     from cup2d_tpu.profiling import METRICS_SCHEMA_VERSION
-    assert METRICS_SCHEMA_VERSION == 7
-    assert METRICS_KEYS == _SCHEMA_V7_KEYS
+    assert METRICS_SCHEMA_VERSION == 8
+    assert METRICS_KEYS == _SCHEMA_V8_KEYS
 
 
+@pytest.mark.slow   # ~17 s; duplicative tier-1 coverage: the frozen key
+#                     SET is pinned as a literal tuple in
+#                     test_metrics_schema_v8_key_set_pinned and the
+#                     uniform producer stream (every record, key-exact)
+#                     in test_cli_metrics_stream_and_post_report; the
+#                     AMR/bench records drilled here ride the identical
+#                     MetricsRecorder.record_step path
 def test_metrics_schema_stable_uniform_amr_bench():
     gold = set(METRICS_KEYS)
 
@@ -118,6 +129,10 @@ def test_metrics_schema_stable_uniform_amr_bench():
     # prec_mode reports the f64 state dtype of _cfg)
     assert r["kernel_tier"] == "xla"
     assert r["prec_mode"] == "f64"
+    # schema v8 BC attribution: the default table's token, and no case
+    # tag on an ad-hoc (non-registry) run
+    assert r["bc_table"] == "fs,fs,fs,fs"
+    assert r["case"] is None
 
     # forest driver path
     asim = _amr_sim()
@@ -299,6 +314,12 @@ def test_metrics_on_bit_identical_equal_pulls(tmp_path, monkeypatch):
     assert traces_b == traces_a
 
 
+@pytest.mark.slow   # ~13 s; duplicative tier-1 coverage: the no-extra-
+#                     device_get contract is pinned on the Simulation
+#                     family by test_metrics_on_bit_identical_equal_
+#                     pulls, and the lagged AMR path's pull accounting
+#                     by test_snapshot_ring (device_gets == n,
+#                     state_gathers == 0 on every record)
 def test_metrics_no_second_pull_on_device_diag(monkeypatch):
     """The obstacle-free AMR step deliberately keeps its diag scalars
     ON DEVICE; the guard's LAGGED verdict pulls them once (batched,

@@ -26,22 +26,14 @@ import numpy as np
 
 
 def _build(D, U, nu, level, xpos, forces_every, bpdy=1):
-    import jax.numpy as jnp  # noqa: F401  (jax init before sim build)
-
+    # the case registry (cases.py) owns the config/shape recipe now;
+    # this probe just adds the force-log plumbing it measures with
     from cup2d_tpu.cache import enable_compilation_cache
-    from cup2d_tpu.config import SimConfig
-    from cup2d_tpu.models import DiskShape
-    from cup2d_tpu.sim import Simulation
+    from cup2d_tpu.cases import make_sim
 
     enable_compilation_cache()
-    cfg = SimConfig(bpdx=4, bpdy=bpdy, level_max=1, level_start=0,
-                    extent=4.0, dtype="float32", nu=nu, lam=1e6, cfl=0.5,
-                    max_poisson_iterations=200, poisson_tol=1e-3,
-                    poisson_tol_rel=1e-2)
-    sim = Simulation(
-        cfg, shapes=[DiskShape(D / 2, xpos, 0.5 * bpdy,
-                               prescribed=(-U, 0.0))],
-        level=level)
+    sim = make_sim("cylinder", D=D, U=U, nu=nu, level=level, xpos=xpos,
+                   bpdy=bpdy)
     sim.compute_forces_every = forces_every
     sim.force_log = io.StringIO()
     sim.initialize()
