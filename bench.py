@@ -677,9 +677,90 @@ def run_poisson_curve(size: int, tol_rel: float = 1e-3,
         }
     return {"grid": f"{size}x{size}", "tol_rel": tol_rel,
             "paths": paths,
+            "forest": run_poisson_forest(n_rep=n_rep),
             "note": ("cold-RHS solves at a fixed relative target; "
                      "iters are platform-independent, ms carries the "
                      "fence methodology of run_size")}
+
+
+def run_poisson_forest(n_rep: int = 3):
+    """Composite-forest solve-path micro-curve (PR 13): the SAME
+    iters-to-tolerance + ms/solve contract as the uniform curve above,
+    but on a genuinely multi-level forest (validation.poisson_ab's
+    vortex-tagged topology) and through the REAL production entry
+    point — each arm times a jitted AMRSim._pressure_project on the
+    cold deltap RHS, so the figure includes the RHS assembly and
+    projection every production solve pays. Arms:
+
+      krylov_jacobi  block-Jacobi-preconditioned BiCGSTAB (the
+                     trigger-off structured default)
+      krylov_fft     mg2-cycle-preconditioned BiCGSTAB (the
+                     CUP2D_POIS=fft production form)
+      forest_fas     forest-native FAS multigrid as the full solver
+                     (CUP2D_POIS=fas; iters are mg_solve CYCLES)
+
+    One fresh sim per arm: _pois_mode is latched and read at trace
+    time, so arms must not share a traced callable. Tolerances are the
+    forest production defaults (tol 1e-3 / tol_rel 1e-2) rather than
+    the uniform curve's 1e-3 relative target — the acceptance claim is
+    about PRODUCTION solves."""
+    from validation.poisson_ab import build_multilevel_sim
+
+    arms = {
+        "krylov_jacobi": (None, False),
+        "krylov_fft": ("fft", True),
+        "forest_fas": ("fas", True),
+    }
+    lat = None
+    paths = {}
+    meta = {}
+    for name, (mode, coarse) in arms.items():
+        sim = build_multilevel_sim(dtype="float32")
+        sim._refresh()
+        if mode is not None:
+            sim._pois_mode = mode
+        sim._coarse_on = coarse
+        tc = sim._use_coarse(False) if coarse else None
+        t = sim._tables
+        ordf = sim._ordered_state()
+        dtv = jnp.asarray(sim.compute_dt(), sim.forest.dtype)
+
+        def solve(v, p, sim=sim, t=t, tc=tc, dtv=dtv):
+            _, _, res, _ = sim._pressure_project(
+                v, p, dtv, sim._h, sim._hsq_flat, t["vec1"],
+                t["sca1"], t["pois"], sim._corr, tc, False,
+                sim._maskv)
+            return res
+
+        js = jax.jit(solve)
+        res = js(ordf["vel"], ordf["pres"])
+        _fence(res.x)
+        if lat is None:
+            lat = _latency_floor(dtv)
+        t0 = time.perf_counter()
+        for _ in range(n_rep):
+            res = js(ordf["vel"], ordf["pres"])
+            _fence(res.x)
+        wall = max((time.perf_counter() - t0 - n_rep * lat) / n_rep,
+                   1e-9)
+        iters = int(res.iters)
+        if not meta:
+            meta = {"n_blocks": int(sim._n_real),
+                    "tol": sim.cfg.poisson_tol,
+                    "tol_rel": sim.cfg.poisson_tol_rel}
+        paths[name] = {
+            "iters": iters,
+            "ms_per_solve": round(wall * 1e3, 3),
+            "ms_per_iter": round(wall / max(iters, 1) * 1e3, 3),
+            "residual": float(res.residual),
+            "converged": bool(res.converged),
+        }
+    return {**meta, "paths": paths,
+            "note": ("cold-RHS _pressure_project solves on the "
+                     "multi-level vortex forest at the production "
+                     "tolerances; forest_fas iters are mg_solve "
+                     "cycles, the Krylov arms' are BiCGSTAB "
+                     "iterations")}
 
 
 def run_kernel_curve(size: int, n_rep: int = 3):

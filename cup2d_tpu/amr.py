@@ -64,8 +64,9 @@ from .ops.obstacle import (
 )
 from .ops.stencil import advect_diffuse_rhs, divergence, dt_from_umax, \
     heun_substage, laplacian5, pressure_gradient_update, vorticity
-from .poisson import apply_block_precond_blocks, bicgstab, \
-    block_precond_matrix, coarse_neumann_solve_dct
+from .poisson import ForestFASCycle, _down2_mean, _up2_bilinear, \
+    apply_block_precond_blocks, bicgstab, block_precond_matrix, \
+    coarse_neumann_solve_dct, mg_solve
 from .profiling import NULL_TIMERS
 from .shapes_host import ShapeHostMixin
 
@@ -87,26 +88,29 @@ class ObstacleForestFields(NamedTuple):
     inertia: jnp.ndarray  # [S]
 
 
-def _up2_bilinear(a: jnp.ndarray) -> jnp.ndarray:
-    """Cell-centered 2x bilinear upsample of a [H, W] image with edge
-    clamp: fine centers sit at quarter offsets, so the separable
-    weights are (3/4, 1/4). Pure slice/stack arithmetic — the ladder
-    step of the structured two-level transfers (no per-cell indices)."""
-    def up1(v):
-        vm = jnp.concatenate([v[:1], v[:-1]], axis=0)
-        vp = jnp.concatenate([v[1:], v[-1:]], axis=0)
-        even = 0.75 * v + 0.25 * vm
-        odd = 0.75 * v + 0.25 * vp
-        return jnp.stack([even, odd], axis=1).reshape(
-            2 * v.shape[0], *v.shape[1:])
-    return up1(up1(a).T).T
+def _tiles_img(entry, rp, bs: int):
+    """Paint one level's uniform image from ordered block rows by ONE
+    block-row gather (the round-5 structured transfer primitive; see
+    _build_coarse_maps). Shared by the two-level preconditioner
+    transfers (_coarse_transfers) and the forest FAS hierarchy's
+    per-level deposits (_fas_transfers)."""
+    own, ownm, _, _ = entry
+    nty, ntx = own.shape
+    img = rp[own.reshape(-1)] * ownm.reshape(-1)[:, None, None]
+    return img.reshape(nty, ntx, bs, bs) \
+              .transpose(0, 2, 1, 3) \
+              .reshape(nty * bs, ntx * bs)
 
 
-def _down2_mean(a: jnp.ndarray) -> jnp.ndarray:
-    """2x2 mean coarsening of a [H, W] image (full-weighting adjoint
-    of nearest prolongation; each fine cell carries weight 1/4)."""
-    rows = a[0::2, :] + a[1::2, :]
-    return 0.25 * (rows[:, 0::2] + rows[:, 1::2])
+def _extract_tiles(a, entry, e, bs: int):
+    """Adjoint of _tiles_img: gather each active block's tile out of a
+    level image and add into the ordered-block accumulator ``e``."""
+    own, _, tid, selp = entry
+    nty, ntx = own.shape
+    tiles = a.reshape(nty, bs, ntx, bs) \
+             .transpose(0, 2, 1, 3) \
+             .reshape(nty * ntx, bs, bs)
+    return e + tiles[tid] * selp[:, None, None]
 
 
 def _raster_neg(cfg, dtype):
@@ -176,13 +180,19 @@ class AMRSim(ShapeHostMixin):
         # ALWAYS-ON two-level coarse correction in the two-grid "mg2"
         # form (pre-smooth, spectral base-level correction, post-
         # smooth; see _pressure_project) instead of waiting for the
-        # iters>15 trigger with the weaker additive form. The
-        # uniform-only "fas"/"fas-f" tokens are rejected here: no FAS
-        # hierarchy exists on the composite forest.
-        if self._pois_mode not in ("structured", "tables", "fft"):
+        # iters>15 trigger with the weaker additive form.
+        # "fas"/"fas-f" (PR 13, formerly uniform-only): multigrid over
+        # the forest's OWN refinement levels as the FULL production
+        # solver (poisson.ForestFASCycle under mg_solve — block-Jacobi
+        # composite smoothing, per-level window-image ladder, exact
+        # DCT-II base solve; fas-f opens every solve base-level-first).
+        # Exact/escalation solves keep Krylov as the robustness
+        # backstop, exactly like the uniform path.
+        if self._pois_mode not in ("structured", "tables", "fft",
+                                   "fas", "fas-f"):
             raise ValueError(
                 f"CUP2D_POIS={self._pois_mode!r}: "
-                "expected structured|tables|fft")
+                "expected structured|tables|fft|fas|fas-f")
         if self._twolevel_form not in (None, "additive", "mult", "mg2"):
             raise ValueError(
                 f"CUP2D_TWOLEVEL={self._twolevel_form!r}: "
@@ -872,37 +882,61 @@ class AMRSim(ShapeHostMixin):
                     return e + apply_block_precond_blocks(
                         r - A(e), self.p_inv)
 
-        # the cold startup solves start from x0 = M(b): one two-level
-        # application removes the global pressure modes from r0 before
-        # the Krylov iteration begins — the zero-pressure first solve
-        # was the 71-iteration outlier of the round-3 probe precisely
-        # because those modes dominated its RHS (VERDICT r3 #9)
-        x0 = None
-        if exact_poisson and tcoarse is not None:
-            x0 = M(b)
-        # exact mode converges THREE ORDERS past the case's own
-        # production target (max(1e-3*tol, 1e-3*tol_rel*|r0|)) — deep
-        # enough that the startup pressure transient is converged for
-        # any consumer of the production tolerances, and anchored to
-        # the case instead of the r2 builds' grid-dependent empirical
-        # f32 floors (VERDICT r2 #8). The stall detector remains the
-        # backstop when that target sits below the precision floor.
-        # Chasing the literal-0 floor instead spent up to 71 iterations
-        # grinding to 1e-8 on the first canonical solve (r3 probe) for
-        # depth nothing reads; this exits at <= 40 (measured).
-        res = bicgstab(
-            A, b, M=M, x0=x0,
-            tol=1e-3 * cfg.poisson_tol if exact_poisson
-            else cfg.poisson_tol,
-            tol_rel=1e-3 * cfg.poisson_tol_rel if exact_poisson
-            else cfg.poisson_tol_rel,
-            max_iter=cfg.max_poisson_iterations,
-            max_restarts=100 if exact_poisson else cfg.max_poisson_restarts,
-            sum_dtype=self.sum_dtype,
-            refresh_every=10 if exact_poisson else 50,
-            stall_iters=15 if exact_poisson else 120,
-            stall_rtol=0.99 if exact_poisson else 0.999,
-        )
+        if self._pois_mode in ("fas", "fas-f") and not exact_poisson:
+            # forest-native FAS production solve (PR 13): multigrid
+            # over the forest's OWN refinement levels as the FULL
+            # solver — mg_solve's true-residual cycle loop (the same
+            # result/stall contract every driver already reads) around
+            # one ForestFASCycle per cycle. _use_coarse guarantees
+            # tcoarse for these modes; exact/escalation solves fall
+            # through to the Krylov backstop below, mirroring the
+            # uniform path (UniformGrid.pressure_solve).
+            paint_fine, base_solve, extract_all = \
+                self._fas_transfers(tcoarse)
+            mgc = ForestFASCycle(
+                A, self._fas_block_smoother(A, tpois),
+                paint_fine, base_solve, extract_all, cih2)
+            res = mg_solve(
+                A, b, mgc,
+                tol=cfg.poisson_tol, tol_rel=cfg.poisson_tol_rel,
+                max_cycles=cfg.max_poisson_iterations,
+                fmg=self._pois_mode == "fas-f",
+            )
+        else:
+            # the cold startup solves start from x0 = M(b): one
+            # two-level application removes the global pressure modes
+            # from r0 before the Krylov iteration begins — the
+            # zero-pressure first solve was the 71-iteration outlier of
+            # the round-3 probe precisely because those modes dominated
+            # its RHS (VERDICT r3 #9)
+            x0 = None
+            if exact_poisson and tcoarse is not None:
+                x0 = M(b)
+            # exact mode converges THREE ORDERS past the case's own
+            # production target (max(1e-3*tol, 1e-3*tol_rel*|r0|)) —
+            # deep enough that the startup pressure transient is
+            # converged for any consumer of the production tolerances,
+            # and anchored to the case instead of the r2 builds'
+            # grid-dependent empirical f32 floors (VERDICT r2 #8). The
+            # stall detector remains the backstop when that target sits
+            # below the precision floor. Chasing the literal-0 floor
+            # instead spent up to 71 iterations grinding to 1e-8 on the
+            # first canonical solve (r3 probe) for depth nothing reads;
+            # this exits at <= 40 (measured).
+            res = bicgstab(
+                A, b, M=M, x0=x0,
+                tol=1e-3 * cfg.poisson_tol if exact_poisson
+                else cfg.poisson_tol,
+                tol_rel=1e-3 * cfg.poisson_tol_rel if exact_poisson
+                else cfg.poisson_tol_rel,
+                max_iter=cfg.max_poisson_iterations,
+                max_restarts=100 if exact_poisson
+                else cfg.max_poisson_restarts,
+                sum_dtype=self.sum_dtype,
+                refresh_every=10 if exact_poisson else 50,
+                stall_iters=15 if exact_poisson else 120,
+                stall_rtol=0.99 if exact_poisson else 0.999,
+            )
 
         # volume-weighted mean removal (main.cpp:7120-7173)
         wsum = jnp.sum(hsq) * cfg.bs ** 2
@@ -950,19 +984,10 @@ class AMRSim(ShapeHostMixin):
             wWc = ww * bs // sc0
             oy, ox = crop[0], crop[1]   # dynamic origin
 
-        def _tiles_img(entry, rp):
-            own, ownm, _, _ = entry
-            nty, ntx = own.shape
-            img = rp[own.reshape(-1)] \
-                * ownm.reshape(-1)[:, None, None]
-            return img.reshape(nty, ntx, bs, bs) \
-                      .transpose(0, 2, 1, 3) \
-                      .reshape(nty * bs, ntx * bs)
-
         def _deposit(rp):
             rc = jnp.zeros((ncy, ncx), rp.dtype)
             for l in sorted(lev):               # levels <= c
-                img = _tiles_img(lev[l], rp)
+                img = _tiles_img(lev[l], rp, bs)
                 # coarser than c: spread the cell's unit deposit
                 # uniformly over its coarse footprint
                 for _ in range(c - l):
@@ -970,7 +995,7 @@ class AMRSim(ShapeHostMixin):
                         jnp.repeat(img, 2, 0), 2, 1) * 0.25
                 rc = rc + img
             for l in sorted(levf):              # levels > c, cropped
-                img = _tiles_img(levf[l], rp)
+                img = _tiles_img(levf[l], rp, bs)
                 # mean ladder: each fine cell deposits its area
                 # fraction 4^(c-l) (the r4 wq weight)
                 for _ in range(l - c):
@@ -981,12 +1006,7 @@ class AMRSim(ShapeHostMixin):
             return rc
 
         def _extract(a, entry, e):
-            own, _, tid, selp = entry
-            nty, ntx = own.shape
-            tiles = a.reshape(nty, bs, ntx, bs) \
-                     .transpose(0, 2, 1, 3) \
-                     .reshape(nty * ntx, bs, bs)
-            return e + tiles[tid] * selp[:, None, None]
+            return _extract_tiles(a, entry, e, bs)
 
         def _interp(ec, like):
             # images are kept ONLY for levels with active blocks; gap
@@ -1011,25 +1031,154 @@ class AMRSim(ShapeHostMixin):
 
         return _deposit, _interp
 
+    def _fas_transfers(self, tcoarse):
+        """Transfer closures of the forest FAS hierarchy
+        (poisson.ForestFASCycle), built from the SAME
+        ``_build_coarse_maps`` pytree as the two-level preconditioner —
+        per-level block-row paints, 2x ladder steps, the cropped
+        active-tile window for levels above c. Returns
+        (paint_fine, base_solve, extract_all):
+
+        * ``paint_fine(rdiv)``: the DIVIDED residual painted as one
+          UNDIVIDED window image per ladder level above c (finest
+          first, gap levels zero) — R_l = rdiv * h_l^2, each block
+          depositing at its OWN level (the composite-forest analog of
+          per-level FAS restriction, arXiv:2510.11152);
+        * ``base_solve(rdiv, racc)``: the full-domain level-c RHS (the
+          <= c block deposits of rdiv plus the restricted fine-level
+          residual ``racc``, undivided -> divided at the window),
+          solved exactly by the DCT-II spectral Neumann solve; returns
+          (ec, window slice of ec);
+        * ``extract_all(ec, es)``: per-level tile extraction of the
+          corrected error back onto the ordered blocks — levels <= c
+          down-laddered from ec, fine levels from their own corrected
+          window images ``es``."""
+        lev = tcoarse["lev"]
+        levf = tcoarse.get("levf", {})
+        crop = tcoarse.get("crop")
+        ncy, ncx = self._coarse_shape
+        c = self._coarse_level
+        bs = self.cfg.bs
+        ch2 = self._coarse_h2
+        dctops = tcoarse["dct"]
+        lf = max(levf) if levf else c
+        if levf:
+            l0 = min(levf)
+            sc0 = 1 << (l0 - c)
+            hw, ww = levf[l0][0].shape
+            wHc = hw * bs // sc0        # window size, coarse cells
+            wWc = ww * bs // sc0
+            oy, ox = crop[0], crop[1]   # dynamic origin
+
+        def paint_fine(rdiv):
+            imgs = []
+            for l in range(lf, c, -1):  # finest ladder level first
+                if l in levf:
+                    img = _tiles_img(levf[l], rdiv, bs) \
+                        * (ch2 / 4 ** (l - c))
+                else:
+                    sc = 1 << (l - c)
+                    img = jnp.zeros((wHc * sc, wWc * sc), rdiv.dtype)
+                imgs.append(img)
+            return imgs
+
+        def base_solve(rdiv, racc):
+            rc = jnp.zeros((ncy, ncx), rdiv.dtype)
+            for l in sorted(lev):       # levels <= c, full domain
+                img = _tiles_img(lev[l], rdiv, bs)
+                # rdiv is POINTWISE (the divided residual ~ lap e), so
+                # a cell coarser than c REPLICATES its value over the
+                # footprint — unlike the preconditioner's 0.25-spread
+                # (_coarse_transfers), which conserves the integral and
+                # underweights sub-base levels by 4^(c-l); Krylov
+                # absorbs that miscalibration, a plain cycle cannot
+                for _ in range(c - l):
+                    img = jnp.repeat(jnp.repeat(img, 2, 0), 2, 1)
+                rc = rc + img
+            awin = None
+            if racc is not None:
+                cur = jax.lax.dynamic_slice(rc, (oy, ox), (wHc, wWc))
+                rc = jax.lax.dynamic_update_slice(
+                    rc, cur + racc / ch2, (oy, ox))
+            ec = coarse_neumann_solve_dct(rc, dctops, ch2)
+            if levf:
+                awin = jax.lax.dynamic_slice(ec, (oy, ox), (wHc, wWc))
+            return ec, awin
+
+        def extract_all(ec, es):
+            e = None
+            for i, l in enumerate(range(lf, c, -1)):
+                if l in levf:
+                    base = jnp.zeros(
+                        (self._npad_hwm, bs, bs), ec.dtype) \
+                        if e is None else e
+                    e = _extract_tiles(es[i], levf[l], base, bs)
+            if e is None:
+                e = jnp.zeros((self._npad_hwm, bs, bs), ec.dtype)
+            if c in lev:
+                e = _extract_tiles(ec, lev[c], e, bs)
+            a = ec
+            for l in range(c - 1, (min(lev) if lev else c) - 1, -1):
+                a = _down2_mean(a)
+                if l in lev:
+                    e = _extract_tiles(a, lev[l], e, bs)
+            return e
+
+        return paint_fine, base_solve, extract_all
+
+    def _fas_block_smoother(self, A, tpois):
+        """Composite-level smoother of the forest FAS cycle: damped
+        block-Jacobi sweeps e += P_inv (r - A e) with the exact
+        single-block inverse (the same GEMM as the Krylov
+        preconditioner). The sharded subclass overrides with the
+        comm/compute-overlapped block-surface form
+        (shard_halo.overlap_block_jacobi_sweeps)."""
+        p_inv = self.p_inv
+
+        def smooth(e, r, n, from_zero=False):
+            if from_zero and n > 0:
+                e = apply_block_precond_blocks(r, p_inv)
+                n -= 1
+            for _ in range(n):
+                e = e + apply_block_precond_blocks(r - A(e), p_inv)
+            return e
+
+        return smooth
+
     @staticmethod
-    def _precond_cycles(res, tcoarse, exact_poisson):
-        """Coarse-correction cycle count of one solve (telemetry schema
-        v4): flexible BiCGSTAB applies M twice per iteration, plus the
-        one x0 = M(b) application of exact-mode cold starts; solves
-        without the two-level operand report 0. ``tcoarse is None`` is
-        a trace-time (pytree-structure) branch, so this costs nothing
-        on device."""
+    def _precond_cycles_static(res, tcoarse, exact_poisson):
         if tcoarse is None:
             return jnp.zeros_like(res.iters)
         return 2 * res.iters + (1 if exact_poisson else 0)
 
+    def _precond_cycles(self, res, tcoarse, exact_poisson):
+        """Coarse-correction cycle count of one solve (telemetry schema
+        v4): flexible BiCGSTAB applies M twice per iteration, plus the
+        one x0 = M(b) application of exact-mode cold starts; solves
+        without the two-level operand report 0. Forest-FAS production
+        solves (CUP2D_POIS=fas|fas-f) run mg_solve, whose iterations
+        ARE cycles — same convention as the uniform FAS path
+        (UniformGrid.precond_cycles). ``tcoarse is None`` is a
+        trace-time (pytree-structure) branch, so this costs nothing
+        on device."""
+        if self._pois_mode in ("fas", "fas-f") and not exact_poisson:
+            return res.iters
+        return self._precond_cycles_static(res, tcoarse, exact_poisson)
+
     @property
     def poisson_mode(self) -> str:
-        """Active production solve-path latch (telemetry schema v4):
-        the CUP2D_POIS mode plus the two-level trigger state, so an A/B
-        run's metrics.jsonl alone says which path each step took."""
+        """Active production solve-path latch (telemetry schema v4 —
+        the value vocabulary grew in PR 13, the KEY set did not): the
+        CUP2D_POIS mode plus the two-level trigger state, so an A/B
+        run's metrics.jsonl alone says which path each step took.
+        Forest values: bicgstab+jacobi | bicgstab+twolevel |
+        bicgstab+fft | fas+forest | fas-f+forest (the "+forest" suffix
+        keeps the forest FAS hierarchy distinguishable from the
+        uniform path's plain "fas"/"fas-f" in merged fleet streams)."""
         if self._pois_mode == "fft":
             return "bicgstab+fft"
+        if self._pois_mode in ("fas", "fas-f"):
+            return self._pois_mode + "+forest"
         return ("bicgstab+twolevel" if self._coarse_on
                 else "bicgstab+jacobi")
 
@@ -1658,9 +1807,11 @@ class AMRSim(ShapeHostMixin):
         the correction ALWAYS on for production solves — cutting
         iterations is the point of that mode, so it never waits for
         the trigger's evidence (``_coarse_on`` is still set, so the
-        guard's replay trigger-state record stays truthful)."""
+        guard's replay trigger-state record stays truthful). The
+        forest-FAS modes (fas/fas-f) likewise: the hierarchy IS the
+        solver, so its maps are unconditionally engaged."""
         if not exact:
-            if self._pois_mode == "fft":
+            if self._pois_mode in ("fft", "fas", "fas-f"):
                 self._coarse_on = True
             if not self._coarse_on and self._last_iters > 15:
                 self._coarse_on = True

@@ -252,6 +252,34 @@ class ShardedAMRSim(AMRSim):
         partition, main.cpp:5205-5330)."""
         return jax.device_put(x, NamedSharding(self.mesh, P("x")))
 
+    def _fas_block_smoother(self, A, tpois):
+        """Forest-FAS composite smoother on the mesh: the
+        comm/compute-overlapped block-surface sweep
+        (shard_halo.overlap_block_jacobi_sweeps) — one shard_map whose
+        per-sweep body issues the surface ppermutes first and hides
+        them behind the shard-local strip/GEMM work. Termwise
+        identical to the parent's per-sweep A + P_inv composition, so
+        the sharded == single-device FAS equality rides the same bound
+        as every other sharded path. Replicated-table fallback (n_pad
+        not divisible by the mesh) keeps the parent form."""
+        from ..poisson import apply_block_precond_blocks
+        from .shard_halo import ShardPoissonOp, \
+            overlap_block_jacobi_sweeps
+        if not isinstance(tpois, ShardPoissonOp):
+            return super()._fas_block_smoother(A, tpois)
+        p_inv = self.p_inv
+
+        def smooth(e, r, n, from_zero=False):
+            if from_zero and n > 0:
+                e = self._shard_blocks(
+                    apply_block_precond_blocks(r, p_inv))
+                n -= 1
+            if n > 0:
+                e = overlap_block_jacobi_sweeps(e, r, p_inv, tpois, n)
+            return e
+
+        return smooth
+
     # -- sharding constraints inside the jitted stages -----------------
     def _advect_rk2(self, vel, h, dt, t3, corr, maskv):
         v = super()._advect_rk2(vel, h, dt, t3, corr, maskv)

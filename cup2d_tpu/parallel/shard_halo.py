@@ -708,6 +708,62 @@ def shard_poisson_op(op, n_pad: int, mesh: Mesh,
                           offsets=offsets, mode=mode, perms=perms)
 
 
+def overlap_block_jacobi_sweeps(e: jnp.ndarray, r: jnp.ndarray,
+                                p_inv: jnp.ndarray, t: ShardPoissonOp,
+                                n: int) -> jnp.ndarray:
+    """``n`` composite block-Jacobi sweeps ``e += P_inv (r - A e)`` on
+    the block-sharded forest — the finest-level smoother of the forest
+    FAS solver (poisson.ForestFASCycle via
+    forest_mesh.ShardedAMRSim._fas_block_smoother), with the
+    block-surface exchange latency made hideable: ONE shard_map whose
+    per-sweep body ISSUES the per-offset surface ppermutes first
+    (_exchange_surface), computes the within-block 5-point part and
+    every own-neighbor strip from purely local data inside the
+    collective's latency window, and consumes the received buffer only
+    in the remote-neighbor gathers; the P_inv GEMM is shard-local.
+    Extends ``overlap_jacobi_sweeps``'s issue-comms-first structure
+    (arXiv:1309.7128) from the uniform x-split to the forest's
+    block-surface exchange.
+
+    Arithmetic is TERMWISE identical to the unoverlapped composition
+    ``e + apply_block_precond_blocks(r - A(e), p_inv)`` with
+    A = ``_poisson_apply_sharded``: the sweep body runs the same
+    flux._structured_lap strip math over the same [own ++ received]
+    gather space and the same GEMM, so sweeps agree with the
+    single-shard_map-per-sweep form to the last bit
+    (tests/test_forest_mesh.py pins <= 1e-12)."""
+    from ..flux import _structured_lap
+
+    @partial(_shard_map, mesh=t.mesh,
+             in_specs=(P("x"),) * 10 + (P(),) * 6, out_specs=P("x"))
+    def run(e0, r_loc, pack, nba, nbb, ms, mc, mf, mw, par,
+            p_inv_r, wc0, wc1, mcl, mfr, d2own):
+        pack = tuple(p[0] for p in pack)
+        nba, nbb, ms, mc, mf, mw, par = (
+            a[0] for a in (nba, nbb, ms, mc, mf, mw, par))
+        B, bs_, _ = e0.shape
+
+        def sweep(_, ee):
+            # 1. exchange in flight
+            recv = _exchange_surface(ee, pack, t)
+            # 2. local window: own-block stencil + own-neighbor strips
+            #    (blocks[:B] = ee) — _structured_lap's gathers of local
+            #    sources depend only on ee; 3. remote-sourced strips
+            #    consume recv
+            blocks = jnp.concatenate([ee, recv], axis=0)
+            lap = _structured_lap(ee, blocks, nba, nbb, ms, mc, mf,
+                                  mw, par, (wc0, wc1, mcl, mfr, d2own))
+            z = ((r_loc - lap).reshape(B, bs_ * bs_)
+                 @ p_inv_r.T).reshape(B, bs_, bs_)
+            return ee + z
+
+        return jax.lax.fori_loop(0, n, sweep, e0)
+
+    return run(e, r, t.pack, t.nba, t.nbb, t.m_same, t.m_coarse,
+               t.m_fine, t.m_wall, t.par, p_inv, t.wc0, t.wc1,
+               t.mcl, t.mfr, t.d2own)
+
+
 def _poisson_apply_sharded(x: jnp.ndarray, t: ShardPoissonOp):
     """A(x) for [n_pad, BS, BS] ordered x sharded on the block axis:
     issue the surface exchange, then run the shared structured strip

@@ -360,7 +360,12 @@ METRICS_KEYS = (
     # path latch as a string (drivers' .poisson_mode — CUP2D_POIS mode
     # + trigger state) and the per-step preconditioner/MG cycle count
     # (rides the one diag pull), so an A/B run is attributable from
-    # metrics.jsonl alone
+    # metrics.jsonl alone. The VALUE vocabulary grew twice without a
+    # schema bump (no keys moved): uniform "bicgstab+mg | fas | fas-f",
+    # forest "bicgstab+jacobi | bicgstab+twolevel | bicgstab+fft |
+    # fas+forest | fas-f+forest" (PR 13 — forest-native FAS as the
+    # full solver; there precond_cycles == poisson_iters, one mg_solve
+    # cycle per outer iteration, vs the Krylov arms' 2 M-applies/iter)
     "poisson_mode", "precond_cycles",
     # kernel-tier attribution (schema v6, PR 9): the ACTIVE advection
     # kernel tier latch (drivers' .kernel_tier — xla | pallas-fused |

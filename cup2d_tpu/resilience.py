@@ -596,13 +596,16 @@ class StepGuard:
         # least one pending verdict must be production (exact verdicts
         # cannot deliver the count that closes the window, _commit),
         # and the sim must actually CONSULT the trigger — under
-        # CUP2D_POIS=fft the correction is forced on unconditionally
-        # (amr._use_coarse), so the pulled count decides nothing and
-        # the drain would just re-tax every post-regrid step.
+        # CUP2D_POIS=fft (and the forest-FAS modes fas/fas-f, whose
+        # hierarchy IS the solver) the correction is forced on
+        # unconditionally (amr._use_coarse), so the pulled count
+        # decides nothing and the drain would just re-tax every
+        # post-regrid step.
         if self.lag and self._trigger_fresh \
                 and hasattr(self.sim, "_coarse_on") \
                 and not self.sim._coarse_on \
-                and getattr(self.sim, "_pois_mode", None) != "fft" \
+                and getattr(self.sim, "_pois_mode", None) not in (
+                    "fft", "fas", "fas-f") \
                 and not (self.sim.step_count < 10
                          or getattr(self.sim, "_force_exact", False)) \
                 and any(not p.exact for p in self._pendings):

@@ -92,6 +92,22 @@ def test_bench_cpu_smoke():
     for name, p in pc["paths"].items():
         assert p["converged"], (name, p)
         assert p["iters"] >= 1 and p["ms_per_solve"] > 0, (name, p)
+    # composite-forest solve-path block (PR 13): the three forest arms
+    # each ran a real converged production solve on the multi-level
+    # topology. ms/solve ordering is timing-noise-prone on a shared CI
+    # box, so the smoke pins presence + convergence + the CYCLE-count
+    # claim (FAS needs no more outer iterations than mg2-Krylov); the
+    # ms/solve win is the bench box's claim (BENCH JSON), not the
+    # smoke's.
+    fc = pc["forest"]
+    assert "error" not in fc, fc
+    assert set(fc["paths"]) == {"krylov_jacobi", "krylov_fft",
+                                "forest_fas"}
+    for name, p in fc["paths"].items():
+        assert p["converged"], (name, p)
+        assert p["iters"] >= 1 and p["ms_per_solve"] > 0, (name, p)
+    assert (fc["paths"]["forest_fas"]["iters"]
+            <= fc["paths"]["krylov_fft"]["iters"]), fc
     # advection kernel-tier curve (PR 9): all three tiers present (the
     # fused tiers run the REAL kernels in Pallas interpret mode on the
     # CPU box, so this pins the plumbing, schema, and bytes model)

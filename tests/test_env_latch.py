@@ -25,11 +25,12 @@ SANCTIONED_FILES = {"config.py"}
 # enable-once latch, grandfathered with its reason:
 SANCTIONED_SITES = {
     # A/B gates latched per-sim in the constructor (ADVICE r5).
-    # CUP2D_POIS mode values: structured|tables|fft on the forest
-    # (AMRSim validates), plus fas|fas-f on the uniform family — the
-    # UniformGrid constructor is the ONE uniform-side latch; fleet.py
-    # and the parallel/ modules read the GRID's stored latch and stay
-    # env-read-free (this walk enforces it).
+    # CUP2D_POIS mode values: structured|tables|fft|fas|fas-f on the
+    # forest (AMRSim validates; fas/fas-f select the forest-native FAS
+    # full solver since PR 13), and fas|fas-f on the uniform family —
+    # the UniformGrid constructor is the ONE uniform-side latch;
+    # fleet.py and the parallel/ modules read the GRID's stored latch
+    # and stay env-read-free (this walk enforces it).
     # CUP2D_PALLAS (PR 9): the forest's own fused-tier latch — the
     # lab-mode megakernel dispatch in _advect_rk2 reads the stored
     # self._kernel_tier, never the env

@@ -246,3 +246,79 @@ def test_sharded_pois_tables_env_fallback(monkeypatch):
     sh = ShardedAMRSim(_mixed_cfg(), mesh)
     sh._refresh()
     assert isinstance(sh._tables["pois"], ShardTables)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_overlap_block_jacobi_matches_unoverlapped():
+    """The comm/compute-overlapped forest smoother
+    (shard_halo.overlap_block_jacobi_sweeps, PR 13) must be TERMWISE
+    identical to the unoverlapped per-sweep composition
+    e + P_inv (r - A e): the sweep body runs the same
+    flux._structured_lap strip math over the same [own ++ received]
+    gather space and the same GEMM, only the issue order changes —
+    pinned <= 1e-12 over multiple sweeps on a mixed-level forest."""
+    from cup2d_tpu.flux import build_poisson_structured, \
+        poisson_apply_structured
+    from cup2d_tpu.parallel.shard_halo import shard_poisson_op, \
+        overlap_block_jacobi_sweeps
+    from cup2d_tpu.poisson import apply_block_precond_blocks, \
+        block_precond_matrix
+
+    cfg, f = _mixed_three_level_forest()
+    order = f.order()
+    n = len(order)
+    n_pad = 40
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(23)
+    r = rng.standard_normal((n_pad, cfg.bs, cfg.bs))
+    r[n:] = 0.0
+    rj = jnp.asarray(r)
+    op = build_poisson_structured(f, order, n_pad)
+    sop = shard_poisson_op(op, n_pad, mesh)
+    p_inv = jnp.asarray(block_precond_matrix(cfg.bs))
+    # unoverlapped reference: n sweeps of the plain composition on
+    # the single-device structured operator
+    want = apply_block_precond_blocks(rj, p_inv)
+    for _ in range(3):
+        want = want + apply_block_precond_blocks(
+            rj - poisson_apply_structured(want, op), p_inv)
+    got = overlap_block_jacobi_sweeps(
+        apply_block_precond_blocks(rj, p_inv), rj, p_inv, sop, 3)
+    np.testing.assert_allclose(np.asarray(got)[:n],
+                               np.asarray(want)[:n],
+                               rtol=0, atol=1e-12)
+
+
+@pytest.mark.slow   # ~50 s: full sharded-vs-single TRAJECTORY drill
+#                     under CUP2D_POIS=fas — duplicative composition:
+#                     the overlapped smoother's termwise identity is
+#                     tier-1 above, the sharded==single step equality
+#                     is tier-1 for the default path, and the fas
+#                     solve itself is tier-1 in test_solver_modes; this
+#                     drill only pins their composition end-to-end
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_sharded_fas_trajectory_matches_single_device(monkeypatch):
+    from validation.poisson_ab import build_multilevel_sim
+
+    monkeypatch.setenv("CUP2D_POIS", "fas")
+    mesh = make_mesh(8)
+    a = build_multilevel_sim()
+    b = build_multilevel_sim(
+        sim_cls=lambda cfg: ShardedAMRSim(cfg, mesh))
+    assert a._pois_mode == "fas" and b._pois_mode == "fas"
+    for s in (a, b):
+        s._refresh()
+        s._coarse_on = True
+        s._last_iters = 0
+        s._last_iters_dev = None
+    da = a.step_once(1e-3)
+    db = b.step_once(1e-3)
+    assert bool(da["poisson_converged"]) and bool(db["poisson_converged"])
+    assert int(da["poisson_iters"]) == int(db["poisson_iters"])
+    va = a._ordered_state()
+    vb = b._ordered_state()
+    nr = a._n_real
+    dv = float(jnp.max(jnp.abs(va["vel"][:nr] - vb["vel"][:nr])))
+    dp = float(jnp.max(jnp.abs(va["pres"][:nr] - vb["pres"][:nr])))
+    assert dv < 1e-11, dv
+    assert dp < 1e-11, dp

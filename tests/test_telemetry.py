@@ -162,6 +162,36 @@ def test_metrics_schema_stable_uniform_amr_bench():
     assert set(br) == gold
 
 
+def test_metrics_forest_fas_mode_strings(monkeypatch):
+    """Schema v8 KEY set is frozen, but PR 13 grew the poisson_mode
+    VALUE vocabulary: a fas/fas-f-latched forest driver must stamp
+    "fas+forest" / "fas-f+forest" on its records (the "+forest" suffix
+    keeps the forest FAS hierarchy distinguishable from the uniform
+    path's plain "fas"/"fas-f" in merged fleet streams), and the FAS
+    full-solver convention precond_cycles == poisson_iters (one cycle
+    per outer iteration — no Krylov wrapper doubling) must ride the
+    diag unchanged. Recorder-level: the driver-side cycle accounting
+    itself is pinned by test_forest_fas_matches_krylov_pressure."""
+    from cup2d_tpu.amr import AMRSim
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=2, level_start=1,
+                    extent=1.0, dtype="float64")
+    for tok, mode in (("fas", "fas+forest"), ("fas-f", "fas-f+forest")):
+        monkeypatch.setenv("CUP2D_POIS", tok)
+        sim = AMRSim(cfg, shapes=[])
+        r = MetricsRecorder().record_step(
+            step=1, t=0.1, dt=0.1, sim=sim,
+            diag={"poisson_iters": 3, "precond_cycles": 3,
+                  "poisson_converged": True})
+        assert set(r) == set(METRICS_KEYS)      # no new keys rode in
+        assert r["poisson_mode"] == mode
+        assert r["precond_cycles"] == r["poisson_iters"] == 3
+    # the fft latch keeps its pre-PR-13 string: the vocabulary grew,
+    # existing values did not move
+    monkeypatch.setenv("CUP2D_POIS", "fft")
+    sim = AMRSim(cfg, shapes=[])
+    assert sim.poisson_mode == "bicgstab+fft"
+
+
 def test_metrics_jsonl_stream_and_summary(tmp_path):
     sink = EventLog(str(tmp_path / "metrics.jsonl"))
     sim = _sim()
