@@ -1,0 +1,5 @@
+"""``python -m cup2d_tpu.analysis`` entry point."""
+
+from .cli import main
+
+main()

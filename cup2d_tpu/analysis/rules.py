@@ -1,0 +1,756 @@
+"""graftlint rules — the five load-bearing invariants as AST checkers.
+
+Each rule is a heuristic over the AST, tuned to the package's idiom:
+it catches the real hazard patterns (each demonstrated live by a
+seeded-violation fixture in tests/test_analysis.py) while staying
+quiet on the sanctioned sites policy.py tables. False-negative by
+design where static analysis cannot see types (e.g. ``float(x)`` on a
+device value hidden behind an untyped helper) — the runtime counters
+(HostCounters, jit_compiles pins) remain the backstop; this layer
+moves the KNOWN hazard classes to review time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import policy
+from .core import (Finding, Module, Rule, iter_functions, iter_scoped,
+                   qualified_name, scope_matches)
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+def _is_jax_qual(q: Optional[str]) -> bool:
+    return q is not None and (q == "jax" or q.startswith("jax."))
+
+
+def _is_np_qual(q: Optional[str]) -> bool:
+    return q is not None and (q == "numpy" or q.startswith("numpy."))
+
+
+# jax-rooted calls that return HOST values or are pure metadata — not
+# device-array producers for the host-sync / donation dataflow
+_JAX_HOST_PREFIXES = (
+    "jax.device_get", "jax.config", "jax.monitoring", "jax.profiler",
+    "jax.debug", "jax.tree_util", "jax.dtypes", "jax.numpy.dtype",
+    "jax.numpy.ndim", "jax.numpy.shape", "jax.numpy.finfo",
+    "jax.numpy.iinfo",
+)
+
+
+def _is_device_producing(q: Optional[str]) -> bool:
+    """A call into jax that returns device-resident values."""
+    if not _is_jax_qual(q):
+        return False
+    return not any(q == p or q.startswith(p + ".")
+                   for p in _JAX_HOST_PREFIXES)
+
+
+def _call_qual(node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        return qualified_name(node.func, imports)
+    return None
+
+
+def _ordered_walk(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk in source order (iter_child_nodes preserves it)."""
+    for child in ast.iter_child_nodes(node):
+        yield child
+        yield from _ordered_walk(child)
+
+
+def _assign_targets(stmt: ast.AST) -> List[ast.expr]:
+    if isinstance(stmt, ast.Assign):
+        return stmt.targets
+    if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)) \
+            and stmt.value is not None:
+        return [stmt.target]
+    return []
+
+
+def _target_names(targets: Sequence[ast.expr]) -> List[str]:
+    """Bare names bound by an assignment (tuple targets flattened;
+    attribute/subscript targets skipped — per-function dataflow only
+    tracks locals)."""
+    out: List[str] = []
+    for t in targets:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(_target_names(list(t.elts)))
+    return out
+
+
+class _JitInfo:
+    """One name bound to a jax.jit-wrapped callable in this module."""
+
+    __slots__ = ("name", "line", "donate_nums", "static_nums",
+                 "static_names")
+
+    def __init__(self, name: str, line: int):
+        self.name = name
+        self.line = line
+        self.donate_nums: Tuple[int, ...] = ()
+        self.static_nums: Tuple[int, ...] = ()
+        self.static_names: Tuple[str, ...] = ()
+
+
+def _const_ints(node: ast.AST) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _const_strs(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def _jit_call_of(value: ast.AST,
+                 imports: Dict[str, str]) -> Optional[ast.Call]:
+    """The jax.jit(...) call inside ``value``, unwrapping one level of
+    functools.partial(jax.jit, ...) — the two spellings the package
+    uses (plain assignment and decorator)."""
+    if not isinstance(value, ast.Call):
+        return None
+    q = qualified_name(value.func, imports)
+    if q == "jax.jit":
+        return value
+    if q == "functools.partial" and value.args:
+        inner = qualified_name(value.args[0], imports)
+        if inner == "jax.jit":
+            return value
+    return None
+
+
+def collect_jit_bindings(mod: Module) -> Dict[str, _JitInfo]:
+    """name -> _JitInfo for every ``x = jax.jit(f, ...)`` /
+    ``self.x = jax.jit(...)`` assignment and every function decorated
+    with ``jax.jit`` / ``functools.partial(jax.jit, ...)``. Attribute
+    targets are keyed by their terminal attr name — call sites resolve
+    ``anything._step(...)`` against it (module-local heuristic)."""
+    out: Dict[str, _JitInfo] = {}
+
+    def record(name: str, call: ast.Call, line: int) -> None:
+        info = out.setdefault(name, _JitInfo(name, line))
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                info.donate_nums += _const_ints(kw.value)
+            elif kw.arg == "static_argnums":
+                info.static_nums += _const_ints(kw.value)
+            elif kw.arg == "static_argnames":
+                info.static_names += _const_strs(kw.value)
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = getattr(node, "value", None)
+            call = _jit_call_of(value, mod.imports) if value else None
+            if call is None:
+                continue
+            targets = _assign_targets(node)
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    record(t.id, call, node.lineno)
+                elif isinstance(t, ast.Attribute):
+                    record(t.attr, call, node.lineno)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                call = _jit_call_of(dec, mod.imports)
+                if call is not None:
+                    record(node.name, call, node.lineno)
+    return out
+
+
+def _called_binding(call: ast.Call,
+                    bindings: Dict[str, _JitInfo]) -> Optional[_JitInfo]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return bindings.get(f.id)
+    if isinstance(f, ast.Attribute):
+        return bindings.get(f.attr)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# 1. env-latch
+# ---------------------------------------------------------------------------
+
+class EnvLatchRule(Rule):
+    """CUP2D_* env vars are read ONCE at a sanctioned latch point
+    (policy.ENV_LATCH_SITES), never mid-run. The PR-1/PR-2 hazard
+    class: a read inside a jitted body or per-refresh helper lets a
+    mid-run env mutation silently flip an operator form at the next
+    retrace or regrid."""
+
+    name = "env-latch"
+    description = ("CUP2D_* env gates latched once at sanctioned "
+                   "construction points, never read mid-run")
+
+    @staticmethod
+    def _env_var_of(node: ast.AST) -> Optional[str]:
+        """The env var name a node reads, or None. Catches
+        os.environ[...] / os.environ.get|pop|setdefault(...) /
+        os.getenv(...) (and the bare `environ`/`getenv` import-form
+        spellings) — ported verbatim from the PR-2 test walk."""
+        def is_environ(n):
+            return (isinstance(n, ast.Attribute) and n.attr == "environ") \
+                or (isinstance(n, ast.Name) and n.id == "environ")
+
+        def const(n):
+            return n.value if (isinstance(n, ast.Constant)
+                               and isinstance(n.value, str)) \
+                else "<dynamic>"
+
+        if isinstance(node, ast.Subscript) and is_environ(node.value):
+            return const(node.slice)
+        if isinstance(node, ast.Call):
+            f = node.func
+            envget = (isinstance(f, ast.Attribute)
+                      and f.attr in ("get", "pop", "setdefault")
+                      and is_environ(f.value))
+            getenv = ((isinstance(f, ast.Attribute)
+                       and f.attr == "getenv")
+                      or (isinstance(f, ast.Name) and f.id == "getenv"))
+            if envget or getenv:
+                return const(node.args[0]) if node.args else "<dynamic>"
+        return None
+
+    @classmethod
+    def env_reads(cls, mod: Module) -> List[Tuple[str, str, int]]:
+        """(scope, var, lineno) for every constant CUP2D_* env read."""
+        out = []
+        for node, scope in iter_scoped(mod.tree):
+            var = cls._env_var_of(node)
+            if var is not None and var.startswith("CUP2D_"):
+                out.append((scope, var, node.lineno))
+        return out
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        if mod.relpath in policy.ENV_LATCH_FILES:
+            return
+        allowed = {scope: vars_
+                   for (f, scope), vars_ in policy.ENV_LATCH_SITES.items()
+                   if f == mod.relpath}
+        for scope, var, line in self.env_reads(mod):
+            if var in allowed.get(scope, ()):
+                continue
+            yield Finding(
+                self.name, mod.relpath, line, scope,
+                f"reads {var} outside the sanctioned latch sites — "
+                "latch once at construction (policy.ENV_LATCH_SITES) "
+                "and store the value")
+
+    def finalize(self, modules: List[Module]) -> Iterable[Finding]:
+        # policy-reality: every sanctioned (file, scope, var) row must
+        # still name a real latch — a refactor that moves a latch must
+        # move its policy row too (the old
+        # test_latch_allowlist_matches_reality, now a lint finding)
+        by_rel = {m.relpath: m for m in modules}
+        for (rel, scope), vars_ in policy.ENV_LATCH_SITES.items():
+            mod = by_rel.get(rel)
+            if mod is None:
+                continue    # partial lint target: row not in scope
+            found = {v for s, v, _ in self.env_reads(mod) if s == scope}
+            missing = set(vars_) - found
+            if missing:
+                yield Finding(
+                    self.name, rel, 1, scope,
+                    f"stale policy row: expected latched reads of "
+                    f"{sorted(missing)} in scope {scope} — "
+                    "policy.ENV_LATCH_SITES no longer matches reality")
+
+
+# ---------------------------------------------------------------------------
+# 2. host-sync
+# ---------------------------------------------------------------------------
+
+class HostSyncRule(Rule):
+    """Device->host pulls only at the sanctioned pull sites
+    (policy.HOST_SYNC_SITES): the hot loop's contract is ONE batched
+    device_get per step (PR 3/4). Flags jax.device_get calls, .item()
+    pulls, and int()/float()/np.asarray()/np.array() applied to
+    device-producing jax expressions (directly or via a function-local
+    name assigned from one)."""
+
+    name = "host-sync"
+    description = ("device->host transfers only at sanctioned batched "
+                   "pull sites — one device_get per step")
+
+    _COERCIONS = {"int", "float"}
+    _NP_COERCIONS = {"numpy.asarray", "numpy.array"}
+
+    @staticmethod
+    def _device_tainted_names(func: ast.AST,
+                              imports: Dict[str, str]) -> Set[str]:
+        """Function-local names assigned from device-producing jax
+        calls, minus names ALSO assigned from host expressions
+        (order-insensitive approximation: an ambiguous name is not
+        flagged — false negatives over false positives)."""
+        device: Set[str] = set()
+        host: Set[str] = set()
+        for stmt in ast.walk(func):
+            targets = _assign_targets(stmt)
+            if not targets:
+                continue
+            value = stmt.value
+            names = _target_names(targets)
+            q = _call_qual(value, imports)
+            if _is_device_producing(q):
+                device.update(names)
+            else:
+                host.update(names)
+        return device - host
+
+    def _flags_pull(self, node: ast.Call, imports: Dict[str, str],
+                    tainted: Set[str]) -> Optional[str]:
+        """Reason string when ``node`` is a device pull, else None."""
+        q = qualified_name(node.func, imports)
+        if q is not None and (q == "jax.device_get"
+                              or q.endswith(".device_get")
+                              or q == "device_get"):
+            return "jax.device_get"
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" and not node.args:
+            return ".item() scalar pull"
+        coerce = None
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in self._COERCIONS:
+            coerce = node.func.id
+        elif q in self._NP_COERCIONS:
+            coerce = q.replace("numpy.", "np.")
+        if coerce is None or not node.args:
+            return None
+        arg = node.args[0]
+        for sub in ast.walk(arg):
+            sq = _call_qual(sub, imports)
+            if sq == "jax.device_get" or (sq or "").endswith(
+                    ".device_get"):
+                return None     # value already pulled (and that pull
+                #                 is flagged/sanctioned on its own)
+            if _is_device_producing(sq):
+                return (f"{coerce}() on a device-producing jax "
+                        "expression")
+            if isinstance(sub, ast.Name) and sub.id in tainted:
+                return (f"{coerce}() on a device value "
+                        f"({sub.id!r} assigned from a jax call)")
+        return None
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        sanctioned = policy.HOST_SYNC_SITES.get(mod.relpath, ())
+        # function-local device taint, precomputed per enclosing def
+        taint_by_scope: Dict[str, Set[str]] = {}
+        for func, scope in iter_functions(mod.tree):
+            taint_by_scope[scope] = self._device_tainted_names(
+                func, mod.imports)
+        for node, scope in iter_scoped(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if scope_matches(scope, sanctioned):
+                continue
+            tainted = set()
+            for s, t in taint_by_scope.items():
+                if scope == s or scope.startswith(s + "."):
+                    tainted |= t
+            reason = self._flags_pull(node, mod.imports, tainted)
+            if reason:
+                yield Finding(
+                    self.name, mod.relpath, node.lineno, scope,
+                    f"{reason} outside the sanctioned pull sites — "
+                    "ride the step's one batched diag pull "
+                    "(policy.HOST_SYNC_SITES) or move to a cold path")
+
+    def finalize(self, modules: List[Module]) -> Iterable[Finding]:
+        by_rel = {m.relpath: m for m in modules}
+        for rel, scopes in policy.HOST_SYNC_SITES.items():
+            mod = by_rel.get(rel)
+            if mod is None:
+                continue
+            present = {s for _, s in iter_scoped(mod.tree)}
+            for s in scopes:
+                if s not in present:
+                    yield Finding(
+                        self.name, rel, 1, s,
+                        f"stale policy row: sanctioned pull scope "
+                        f"{s!r} no longer exists in {rel} — "
+                        "policy.HOST_SYNC_SITES must move with it")
+
+
+# ---------------------------------------------------------------------------
+# 3. donation-safety
+# ---------------------------------------------------------------------------
+
+class DonationSafetyRule(Rule):
+    """No numpy buffer may flow into a donated argument of a jitted
+    function without an intervening jnp copy — the PR-2 heap-corruption
+    class: XLA donates (frees/reuses) the buffer backing the argument,
+    and when that buffer is numpy-owned host memory the next write
+    corrupts the heap. Data flow is function-local: names assigned from
+    numpy-producing expressions (np.load/npz subscripts/np.asarray/...)
+    are tainted; jnp.array()/jnp.asarray()/device_put clear the taint;
+    unknown calls propagate taint from their arguments (the
+    ``FlowState(*np_buffers)`` constructor shape of the original
+    bug)."""
+
+    name = "donation-safety"
+    description = ("numpy buffers never flow into donated jit "
+                   "arguments without a jnp copy")
+
+    @staticmethod
+    def _np_tainted_names(func: ast.AST,
+                          imports: Dict[str, str]) -> Set[str]:
+        tainted: Set[str] = set()
+
+        def expr_tainted(e: ast.AST) -> bool:
+            if isinstance(e, ast.Name):
+                return e.id in tainted
+            if isinstance(e, ast.Subscript):
+                # npz["vel"], data[k] — subscript of a tainted object
+                return expr_tainted(e.value)
+            if isinstance(e, ast.Attribute):
+                return expr_tainted(e.value)
+            if isinstance(e, (ast.Tuple, ast.List)):
+                return any(expr_tainted(x) for x in e.elts)
+            if isinstance(e, ast.Starred):
+                return expr_tainted(e.value)
+            if isinstance(e, ast.GeneratorExp):
+                return expr_tainted(e.elt)
+            if isinstance(e, ast.Call):
+                q = qualified_name(e.func, imports)
+                if _is_np_qual(q):
+                    return True             # numpy-producing call
+                if _is_jax_qual(q):
+                    return False            # device copy clears taint
+                # unknown call (constructor, helper): tainted iff any
+                # argument is — the FlowState(*npz_buffers) shape
+                return any(expr_tainted(a) for a in e.args) \
+                    or any(expr_tainted(k.value) for k in e.keywords)
+            return False
+
+        # two passes so taint propagates through forward references in
+        # simple cases; source order within a pass
+        for _ in range(2):
+            for stmt in _ordered_walk(func):
+                targets = _assign_targets(stmt)
+                if not targets:
+                    continue
+                names = _target_names(targets)
+                if expr_tainted(stmt.value):
+                    tainted.update(names)
+                else:
+                    tainted.difference_update(names)
+        return tainted
+
+    def _arg_taint(self, arg: ast.AST, tainted: Set[str],
+                   imports: Dict[str, str]) -> Optional[str]:
+        if isinstance(arg, ast.Name) and arg.id in tainted:
+            return f"{arg.id!r} carries a numpy buffer"
+        q = _call_qual(arg, imports)
+        if _is_np_qual(q):
+            return f"direct {q}(...) result"
+        if isinstance(arg, ast.Subscript) and isinstance(
+                arg.value, ast.Name) and arg.value.id in tainted:
+            return f"subscript of numpy-tainted {arg.value.id!r}"
+        return None
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        bindings = {n: i for n, i in collect_jit_bindings(mod).items()
+                    if i.donate_nums}
+        if not bindings:
+            return
+        for func, scope in iter_functions(mod.tree):
+            tainted = self._np_tainted_names(func, mod.imports)
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                info = _called_binding(node, bindings)
+                if info is None:
+                    continue
+                for pos in info.donate_nums:
+                    if pos >= len(node.args):
+                        continue
+                    why = self._arg_taint(node.args[pos], tainted,
+                                          mod.imports)
+                    if why:
+                        yield Finding(
+                            self.name, mod.relpath, node.lineno, scope,
+                            f"donated argument {pos} of "
+                            f"{info.name!r} (jitted with "
+                            f"donate_argnums at line {info.line}): "
+                            f"{why} — XLA will donate numpy-owned "
+                            "memory (PR-2 heap corruption); copy with "
+                            "jnp.array(...) first")
+
+
+# ---------------------------------------------------------------------------
+# 4. retrace-hazard
+# ---------------------------------------------------------------------------
+
+class RetraceHazardRule(Rule):
+    """Static jit operands must be hashable and per-call-stable — the
+    zero-steady-state-recompile discipline (PR 11 pins
+    jit_compiles==0 at runtime; this rule catches the hazard at review
+    time). Flags non-hashable literals (list/dict/set/comprehension),
+    f-strings, str.format()/%-formatting results, and known per-call-
+    varying calls (time.time, id, random.*, uuid.*) flowing into
+    static_argnums/static_argnames positions at call sites of
+    module-local jitted bindings."""
+
+    name = "retrace-hazard"
+    description = ("static jit operands hashable and per-call-stable "
+                   "— no f-strings/literals that retrace every step")
+
+    _UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                   ast.DictComp, ast.SetComp, ast.GeneratorExp)
+    _VARYING_CALLS = ("time.time", "time.monotonic", "time.perf_counter",
+                      "id", "uuid.uuid4", "os.getpid", "random.random",
+                      "random.randint")
+
+    @staticmethod
+    def _formatted_names(func: ast.AST,
+                         imports: Dict[str, str]) -> Set[str]:
+        """Names assigned from f-strings / .format() / str %-formatting
+        within this function."""
+        out: Set[str] = set()
+        for stmt in ast.walk(func):
+            targets = _assign_targets(stmt)
+            if not targets:
+                continue
+            v = stmt.value
+            fmt = isinstance(v, ast.JoinedStr) or (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "format") or (
+                isinstance(v, ast.BinOp)
+                and isinstance(v.op, ast.Mod)
+                and isinstance(v.left, (ast.Constant, ast.JoinedStr)))
+            if fmt:
+                out.update(_target_names(targets))
+        return out
+
+    def _operand_hazard(self, val: ast.AST, formatted: Set[str],
+                        imports: Dict[str, str]) -> Optional[str]:
+        if isinstance(val, self._UNHASHABLE):
+            return ("non-hashable literal (retraces or TypeErrors "
+                    "every call)")
+        if isinstance(val, ast.JoinedStr):
+            return "f-string (a new trace per distinct value)"
+        if isinstance(val, ast.Call):
+            q = qualified_name(val.func, imports)
+            if isinstance(val.func, ast.Attribute) \
+                    and val.func.attr == "format":
+                return ".format() string (a new trace per value)"
+            if q in self._VARYING_CALLS:
+                return f"per-call-varying {q}() value"
+        if isinstance(val, ast.Name) and val.id in formatted:
+            return (f"{val.id!r} holds a formatted string "
+                    "(a new trace per distinct value)")
+        return None
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        bindings = {n: i for n, i in collect_jit_bindings(mod).items()
+                    if i.static_nums or i.static_names}
+        # hazard at declaration: static_argnums/names values must be
+        # compile-time constants for THIS check to vouch for call sites
+        for func, scope in iter_functions(mod.tree):
+            formatted = self._formatted_names(func, mod.imports)
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                info = _called_binding(node, bindings) \
+                    if bindings else None
+                if info is None:
+                    continue
+                for pos in info.static_nums:
+                    if pos >= len(node.args):
+                        continue
+                    why = self._operand_hazard(
+                        node.args[pos], formatted, mod.imports)
+                    if why:
+                        yield Finding(
+                            self.name, mod.relpath, node.lineno, scope,
+                            f"static argument {pos} of {info.name!r}: "
+                            f"{why} — steady state must not recompile "
+                            "(jit_compiles==0 contract)")
+                for kw in node.keywords:
+                    if kw.arg not in info.static_names:
+                        continue
+                    why = self._operand_hazard(
+                        kw.value, formatted, mod.imports)
+                    if why:
+                        yield Finding(
+                            self.name, mod.relpath, node.lineno, scope,
+                            f"static operand {kw.arg!r} of "
+                            f"{info.name!r}: {why} — steady state "
+                            "must not recompile (jit_compiles==0 "
+                            "contract)")
+
+
+# ---------------------------------------------------------------------------
+# 5. leading-dim-agnostic
+# ---------------------------------------------------------------------------
+
+class LeadingDimRule(Rule):
+    """In the contract scopes (policy.LEADING_DIM_SCOPES) field ops
+    address the trailing y/x axes ONLY via ``...`` slicing and negative
+    axis numbers, so one kernel serves uniform [Ny,Nx], fleet
+    [B,Ny,Nx] and forest-lab [N,2,H,W] operands (the contract FleetSim
+    and the PR-9 megakernel silently depend on). Flags positive
+    ``axis=`` constants, ``.shape[k]`` with k >= 0, and hard
+    positional ``[i, j]`` tuple indexing with neither ``...`` nor
+    ``None`` (newaxis shaping and ``...``-anchored slices stay
+    legal)."""
+
+    name = "leading-dim"
+    description = ("contract scopes index fields with '...' and "
+                   "negative axes only — one kernel, any leading dims")
+
+    @staticmethod
+    def _annotation_nodes(tree: ast.AST) -> Set[int]:
+        """ids of every node inside a type annotation —
+        ``Callable[[jnp.ndarray], jnp.ndarray]`` is a tuple-subscript
+        to the AST but not an array indexing."""
+        out: Set[int] = set()
+
+        def mark(node: Optional[ast.AST]) -> None:
+            if node is None:
+                return
+            for sub in ast.walk(node):
+                out.add(id(sub))
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mark(node.returns)
+                a = node.args
+                for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                            + ([a.vararg] if a.vararg else [])
+                            + ([a.kwarg] if a.kwarg else [])):
+                    mark(arg.annotation)
+            elif isinstance(node, ast.AnnAssign):
+                mark(node.annotation)
+        return out
+
+    @staticmethod
+    def _pos_axis(node: ast.Call) -> Optional[int]:
+        for kw in node.keywords:
+            if kw.arg not in ("axis", "axes", "dimension"):
+                continue
+            vals = _const_ints(kw.value)
+            for v in vals:
+                if v >= 0:
+                    return v
+        return None
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        scopes = policy.LEADING_DIM_SCOPES.get(mod.relpath)
+        if not scopes:
+            return
+        whole_file = "*" in scopes
+        in_annotation = self._annotation_nodes(mod.tree)
+        for node, scope in iter_scoped(mod.tree):
+            if not (whole_file or scope_matches(scope, scopes)):
+                continue
+            if id(node) in in_annotation:
+                continue
+            if isinstance(node, ast.Call):
+                v = self._pos_axis(node)
+                if v is not None:
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno, scope,
+                        f"positional axis={v} counts from the front — "
+                        "the leading-dim contract requires negative "
+                        "axes (-2/-1 for y/x)")
+            elif isinstance(node, ast.Subscript):
+                # .shape[k], k >= 0
+                if isinstance(node.value, ast.Attribute) \
+                        and node.value.attr == "shape":
+                    idx = node.slice
+                    if isinstance(idx, ast.Constant) \
+                            and isinstance(idx.value, int) \
+                            and idx.value >= 0:
+                        yield Finding(
+                            self.name, mod.relpath, node.lineno, scope,
+                            f".shape[{idx.value}] counts from the "
+                            "front — use negative indices "
+                            "(shape[-2]/shape[-1]) so leading dims "
+                            "pass through")
+                    continue
+                # hard [i, j] indexing without '...' anchor
+                if isinstance(node.slice, ast.Tuple) \
+                        and len(node.slice.elts) >= 2:
+                    elts = node.slice.elts
+                    has_ellipsis = any(
+                        isinstance(e, ast.Constant) and e.value is ...
+                        for e in elts)
+                    has_newaxis = any(
+                        isinstance(e, ast.Constant) and e.value is None
+                        for e in elts)
+                    if not has_ellipsis and not has_newaxis:
+                        yield Finding(
+                            self.name, mod.relpath, node.lineno, scope,
+                            "hard positional [i, j] indexing — anchor "
+                            "with '...' so the op stays leading-dim "
+                            "agnostic")
+
+    def finalize(self, modules: List[Module]) -> Iterable[Finding]:
+        by_rel = {m.relpath: m for m in modules}
+        for rel, scopes in policy.LEADING_DIM_SCOPES.items():
+            mod = by_rel.get(rel)
+            if mod is None:
+                continue
+            present = {s for _, s in iter_scoped(mod.tree)}
+            for s in scopes:
+                if s != "*" and s not in present:
+                    yield Finding(
+                        self.name, rel, 1, s,
+                        f"stale policy row: contract scope {s!r} no "
+                        f"longer exists in {rel} — "
+                        "policy.LEADING_DIM_SCOPES must move with it")
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+ALL_RULES = (EnvLatchRule, HostSyncRule, DonationSafetyRule,
+             RetraceHazardRule, LeadingDimRule)
+
+RULE_NAMES = tuple(r.name for r in ALL_RULES)
+
+
+def make_rules(only: Optional[Sequence[str]] = None,
+               skip: Optional[Sequence[str]] = None) -> List[Rule]:
+    """Instantiate the selected rules; unknown names are a
+    LintConfigError (CLI rc 2)."""
+    from .core import LintConfigError
+
+    known = set(RULE_NAMES)
+    for group in (only or ()), (skip or ()):
+        for n in group:
+            if n not in known:
+                raise LintConfigError(
+                    f"unknown rule {n!r} (known: {sorted(known)})")
+    sel = []
+    for cls in ALL_RULES:
+        if only and cls.name not in only:
+            continue
+        if skip and cls.name in skip:
+            continue
+        sel.append(cls())
+    if not sel:
+        raise LintConfigError("rule selection is empty")
+    return sel

@@ -616,7 +616,7 @@ def bicgstab(
     # climbing after a member froze, which would misclassify an early
     # give-up exit as a stall
     stalled = ~converged & ((final.it_m - final.impr_it) >= stall_iters)
-    sq = (lambda v: v.reshape(v.shape[0])) if member_axis else (lambda v: v)
+    sq = (lambda v: v.reshape(-1)) if member_axis else (lambda v: v)
     return BiCGSTABResult(
         x=jnp.where(use_x, final.x, final.x_opt),
         iters=sq(final.it_m) if member_axis else final.it,
@@ -769,7 +769,7 @@ def mg_solve(
     final = jax.lax.while_loop(cond, body, init)
     converged = final.norm <= target
     stalled = ~converged & (final.no_impr >= stall_cycles)
-    sq = (lambda v: v.reshape(v.shape[0])) if member_axis else (lambda v: v)
+    sq = (lambda v: v.reshape(-1)) if member_axis else (lambda v: v)
     return BiCGSTABResult(
         x=final.x,
         iters=sq(final.it_m) if member_axis else final.it,

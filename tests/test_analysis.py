@@ -1,0 +1,458 @@
+"""graftlint (cup2d_tpu.analysis) — framework, rules, CLI.
+
+Every rule is demonstrated LIVE on a seeded-violation snippet compiled
+from strings (never from repo files, so the fixtures can't rot with
+the tree) next to a clean twin that must pass; the suppression syntax
+is pinned including its failure mode (an allow without a reason is a
+config error, rc 2); and the CLI is smoke-pinned the way
+test_bench_smoke.py pins bench — a real subprocess, rc semantics and
+one JSON line, with the ``--only env-latch`` run agreeing with the
+pytest wrapper in test_env_latch.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cup2d_tpu.analysis import (LintConfigError, lint_package,
+                                lint_sources)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _findings(sources, only=None):
+    return lint_sources(sources, only=only).findings
+
+
+def _rules_hit(sources, only=None):
+    return {f.rule for f in _findings(sources, only=only)}
+
+
+# ---------------------------------------------------------------------------
+# env-latch
+# ---------------------------------------------------------------------------
+
+ENV_BAD = """\
+import os
+
+def refresh(self):
+    mode = os.environ.get("CUP2D_POIS", "structured")
+    return mode
+"""
+
+ENV_CLEAN = """\
+import os
+
+def refresh(self):
+    return self._pois_mode       # reads the latched value, not the env
+"""
+
+
+def test_env_latch_flags_unsanctioned_read():
+    fs = _findings({"somefile.py": ENV_BAD}, only=["env-latch"])
+    assert len(fs) == 1
+    assert fs[0].rule == "env-latch"
+    assert fs[0].scope == "refresh"
+    assert "CUP2D_POIS" in fs[0].message
+
+
+def test_env_latch_clean_twin_passes():
+    assert not _findings({"somefile.py": ENV_CLEAN}, only=["env-latch"])
+
+
+def test_env_latch_sanctioned_site_passes():
+    # the same read of a policy-listed var at its (file, scope) latch
+    src = ENV_BAD.replace("def refresh(self):",
+                          "def enable_compilation_cache():") \
+        .replace("CUP2D_POIS", "CUP2D_CACHE")
+    # note: finalize will flag the OTHER policy vars as stale for
+    # cache.py; restrict to the read check by asserting no finding on
+    # the read's line
+    fs = _findings({"cache.py": src}, only=["env-latch"])
+    assert not [f for f in fs if "outside the sanctioned" in f.message]
+
+
+def test_env_latch_config_file_fully_sanctioned():
+    assert not [f for f in _findings({"config.py": ENV_BAD},
+                                     only=["env-latch"])
+                if "outside the sanctioned" in f.message]
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+
+SYNC_BAD = """\
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def step_diag(self, vel):
+    umax = float(jnp.max(jnp.abs(vel)))      # per-scalar pull
+    return umax
+"""
+
+SYNC_BAD_TAINT = """\
+import jax.numpy as jnp
+import numpy as np
+
+def step_diag(self, vel):
+    nrm = jnp.linalg.norm(vel)
+    return np.asarray(nrm)                   # pull via tainted name
+"""
+
+SYNC_BAD_ITEM = """\
+import jax.numpy as jnp
+
+def step_diag(self, vel):
+    return jnp.max(vel).item()
+"""
+
+SYNC_CLEAN = """\
+import jax
+import jax.numpy as jnp
+
+def step_diag(self, vel):
+    # stays on device; the driver's ONE batched pull fetches it
+    return jnp.max(jnp.abs(vel))
+
+def cold_restore(path, host_buf):
+    # host math on host values is not a sync
+    return float(sum(host_buf))
+"""
+
+
+def test_host_sync_flags_scalar_pull():
+    assert _rules_hit({"driver.py": SYNC_BAD}) == {"host-sync"}
+    assert _rules_hit({"driver.py": SYNC_BAD_TAINT}) == {"host-sync"}
+    assert _rules_hit({"driver.py": SYNC_BAD_ITEM}) == {"host-sync"}
+
+
+def test_host_sync_clean_twin_passes():
+    assert not _findings({"driver.py": SYNC_CLEAN}, only=["host-sync"])
+
+
+def test_host_sync_sanctioned_scope_passes():
+    # fleet.py's FleetSim.step_once is a sanctioned pull site
+    src = """\
+import jax
+import jax.numpy as jnp
+
+class FleetSim:
+    def step_once(self, vel):
+        umax = float(jnp.max(jnp.abs(vel)))
+        return umax
+"""
+    # (the finalize pass rightly flags the OTHER sanctioned fleet.py
+    # scopes as missing from this one-class fixture — not under test)
+    fs = _findings({"fleet.py": src}, only=["host-sync"])
+    assert not [f for f in fs if "stale policy row" not in f.message]
+
+
+def test_host_sync_device_get_of_pulled_value_not_double_flagged():
+    src = """\
+import jax
+
+def cold(self, diag):
+    host = jax.device_get(diag)
+    return float(host)
+"""
+    fs = _findings({"driver.py": src}, only=["host-sync"])
+    # exactly the device_get itself — float() of an already-pulled
+    # host value is not a second sync
+    assert len(fs) == 1 and "device_get" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# donation-safety
+# ---------------------------------------------------------------------------
+
+DON_BAD = """\
+import jax
+import numpy as np
+
+_step = jax.jit(lambda st, dt: st, donate_argnums=(0,))
+
+def restore(path, dt):
+    npz = np.load(path)
+    st = npz["vel"]
+    return _step(st, dt)
+"""
+
+DON_BAD_WRAPPED = """\
+import jax
+import numpy as np
+
+_step = jax.jit(lambda st, dt: st, donate_argnums=(0,))
+
+def restore(path, dt):
+    npz = np.load(path)
+    st = FlowState(npz["vel"], npz["p"])     # constructor wraps buffers
+    return _step(st, dt)
+"""
+
+DON_CLEAN = """\
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_step = jax.jit(lambda st, dt: st, donate_argnums=(0,))
+
+def restore(path, dt):
+    npz = np.load(path)
+    st = jnp.array(npz["vel"])               # owning device copy
+    return _step(st, dt)
+"""
+
+
+def test_donation_flags_numpy_into_donated_arg():
+    assert _rules_hit({"io2.py": DON_BAD},
+                      only=["donation-safety"]) == {"donation-safety"}
+
+
+def test_donation_flags_constructor_wrapped_buffers():
+    assert _rules_hit({"io2.py": DON_BAD_WRAPPED},
+                      only=["donation-safety"]) == {"donation-safety"}
+
+
+def test_donation_clean_twin_passes():
+    assert not _findings({"io2.py": DON_CLEAN}, only=["donation-safety"])
+
+
+def test_donation_non_donated_arg_passes():
+    # dt position is not donated — numpy there is legal
+    src = DON_CLEAN.replace("return _step(st, dt)",
+                            "return _step(st, np.float64(dt))")
+    assert not _findings({"io2.py": src}, only=["donation-safety"])
+
+
+# ---------------------------------------------------------------------------
+# retrace-hazard
+# ---------------------------------------------------------------------------
+
+RET_BAD_FSTRING = """\
+import jax
+
+_run = jax.jit(lambda v: v, static_argnames=("mode",))
+
+def serve(v, i):
+    return _run(v, mode=f"case-{i}")
+"""
+
+RET_BAD_LIST = """\
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _run(v, shape):
+    return v
+
+def serve(v, ny, nx):
+    return _run(v, [ny, nx])
+"""
+
+RET_CLEAN = """\
+import jax
+
+_run = jax.jit(lambda v: v, static_argnames=("mode",))
+
+def serve(v, mode):
+    return _run(v, mode=mode)        # hashable, caller-stable
+
+def serve2(v, ny, nx):
+    return _run(v, mode=(ny, nx))    # tuple is hashable
+"""
+
+
+def test_retrace_flags_fstring_static_operand():
+    assert _rules_hit({"srv.py": RET_BAD_FSTRING},
+                      only=["retrace-hazard"]) == {"retrace-hazard"}
+
+
+def test_retrace_flags_unhashable_static_operand():
+    assert _rules_hit({"srv.py": RET_BAD_LIST},
+                      only=["retrace-hazard"]) == {"retrace-hazard"}
+
+
+def test_retrace_clean_twin_passes():
+    assert not _findings({"srv.py": RET_CLEAN}, only=["retrace-hazard"])
+
+
+# ---------------------------------------------------------------------------
+# leading-dim
+# ---------------------------------------------------------------------------
+
+LEAD_BAD = """\
+import jax.numpy as jnp
+
+def laplacian(u, h):
+    ny = u.shape[0]                          # front-counted rank
+    c = u[1, 2]                              # hard positional index
+    return jnp.sum(u, axis=0) / h            # positional axis
+"""
+
+LEAD_CLEAN = """\
+import jax.numpy as jnp
+
+def laplacian(u, h):
+    ny = u.shape[-2]
+    c = u[..., 1, 2]
+    ex = u[:, None]                          # newaxis shaping is legal
+    return jnp.sum(u, axis=-2) / h
+"""
+
+
+def test_leading_dim_flags_front_indexing():
+    # only fires in policy-listed contract files
+    fs = _findings({"ops/stencil.py": LEAD_BAD}, only=["leading-dim"])
+    assert len(fs) == 3
+    assert {f.rule for f in fs} == {"leading-dim"}
+
+
+def test_leading_dim_clean_twin_passes():
+    assert not _findings({"ops/stencil.py": LEAD_CLEAN},
+                         only=["leading-dim"])
+
+
+def test_leading_dim_ignores_files_outside_contract():
+    assert not _findings({"somewhere_else.py": LEAD_BAD},
+                         only=["leading-dim"])
+
+
+def test_leading_dim_ignores_type_annotations():
+    src = """\
+from typing import Callable
+import jax.numpy as jnp
+
+def solve(A: Callable[[jnp.ndarray], jnp.ndarray], b):
+    return A(b)
+"""
+    assert not _findings({"ops/stencil.py": src}, only=["leading-dim"])
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def test_suppression_with_reason_silences_finding():
+    src = SYNC_BAD.replace(
+        "    umax = float(jnp.max(jnp.abs(vel)))      # per-scalar pull",
+        "    # lint: allow[host-sync] -- cold path, once per restore\n"
+        "    umax = float(jnp.max(jnp.abs(vel)))")
+    rep = lint_sources({"driver.py": src}, only=["host-sync"])
+    assert rep.clean
+    assert rep.suppressed.get("host-sync") == 1
+
+
+def test_suppression_without_reason_is_config_error():
+    src = SYNC_BAD.replace(
+        "# per-scalar pull", "# lint: allow[host-sync]")
+    with pytest.raises(LintConfigError, match="without a reason"):
+        lint_sources({"driver.py": src})
+
+
+def test_suppression_unknown_rule_is_config_error():
+    src = SYNC_BAD.replace(
+        "# per-scalar pull", "# lint: allow[no-such-rule] -- because")
+    with pytest.raises(LintConfigError, match="unknown"):
+        lint_sources({"driver.py": src})
+
+
+def test_unknown_rule_selection_is_config_error():
+    with pytest.raises(LintConfigError, match="unknown rule"):
+        lint_sources({"x.py": "pass\n"}, only=["no-such-rule"])
+
+
+# ---------------------------------------------------------------------------
+# package runs clean + stays import-light
+# ---------------------------------------------------------------------------
+
+def test_package_lints_clean_in_process():
+    report = lint_package()
+    assert report.clean, "\n".join(str(f) for f in report.findings)
+    assert report.files_scanned > 30
+    assert set(report.rules_run) == {
+        "env-latch", "host-sync", "donation-safety", "retrace-hazard",
+        "leading-dim"}
+
+
+def test_analysis_package_never_imports_jax():
+    # the jax-import-free contract, proven in a pristine interpreter
+    # (the lazy parent package pulls numpy via curve.py; jax is the
+    # heavy dependency the lint must run without)
+    code = ("import sys; import cup2d_tpu.analysis as a; "
+            "a.lint_package(); "
+            "bad = [m for m in sys.modules if m.split('.')[0] in "
+            "('jax', 'jaxlib')]; "
+            "sys.exit(2 if bad else 0)")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=ROOT,
+        env={**os.environ, "PYTHONPATH": ROOT}, capture_output=True)
+    assert proc.returncode == 0, proc.stderr.decode()
+
+
+# ---------------------------------------------------------------------------
+# CLI smoke (subprocess, like test_bench_smoke.py)
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args, inputs=None):
+    return subprocess.run(
+        [sys.executable, "-m", "cup2d_tpu.analysis", *args],
+        cwd=ROOT, env={**os.environ, "PYTHONPATH": ROOT},
+        capture_output=True, text=True)
+
+
+def test_cli_json_clean_on_head():
+    proc = _run_cli("--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, "ONE machine-readable JSON line"
+    payload = json.loads(lines[0])
+    assert payload["graftlint"] == 1
+    assert payload["clean"] is True
+    assert payload["findings"] == []
+    assert set(payload["counts"]) == {
+        "env-latch", "host-sync", "donation-safety", "retrace-hazard",
+        "leading-dim"}
+    assert all(v == 0 for v in payload["counts"].values())
+    assert payload["files_scanned"] > 30
+
+
+def test_cli_only_env_latch_agrees_with_pytest_wrapper():
+    proc = _run_cli("--json", "--only", "env-latch")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout.strip())
+    assert payload["rules"] == ["env-latch"]
+    # the pytest wrapper (test_env_latch.py) asserts the same thing
+    # in-process; both must agree
+    report = lint_package(only=["env-latch"])
+    assert payload["clean"] == report.clean
+    assert payload["counts"]["env-latch"] == len(report.findings)
+
+
+def test_cli_rc1_on_findings(tmp_path):
+    bad = tmp_path / "dirty.py"
+    bad.write_text("import os\nV = os.environ['CUP2D_POIS']\n")
+    proc = _run_cli(str(bad))
+    assert proc.returncode == 1
+    assert "env-latch" in proc.stdout
+
+
+def test_cli_rc2_on_config_error(tmp_path):
+    proc = _run_cli("--only", "no-such-rule")
+    assert proc.returncode == 2
+    bad = tmp_path / "noreason.py"
+    bad.write_text("x = 1  # lint: allow[host-sync]\n")
+    proc = _run_cli(str(bad))
+    assert proc.returncode == 2
+
+
+def test_cli_list_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule in ("env-latch", "host-sync", "donation-safety",
+                 "retrace-hazard", "leading-dim"):
+        assert rule in proc.stdout

@@ -1,149 +1,33 @@
-"""Static guard for the env-latching convention (ADVICE r5 / PR 1).
+"""Env gates are latched ONCE at sanctioned sites (thin wrapper).
 
-Every CUP2D_* environment gate must be LATCHED — read exactly once at a
-sanctioned construction/enable point and stored — never consulted
-mid-run: a read inside a jitted body or a per-refresh helper means a
-mid-run env mutation silently flips an operator/preconditioner form at
-the next retrace or regrid (the hazard class CUP2D_SHARD_EXCHANGE and
-CUP2D_POIS/CUP2D_TWOLEVEL were each fixed for). This test walks the
-package AST and fails on any CUP2D_* read outside the sanctioned latch
-sites below — adding a new gate means adding a new latch site HERE, on
-purpose, with a reason.
+The bespoke AST walk that lived here since PR 2 moved into the
+graftlint framework (``cup2d_tpu.analysis``): the sanctioned-site
+table is now ``analysis/policy.py`` data (the single source of truth
+— there is deliberately no second copy in this file), the walk is the
+``env-latch`` rule, and this test just asserts the rule runs clean on
+the package. The old reality check (every allowlist row still names a
+real latch) is the rule's finalize pass: a stale row IS a finding, so
+the clean assertion covers it; the monkeypatch test below proves the
+detector actually fires.
 """
 
-import ast
-import os
-
-PKG = os.path.normpath(
-    os.path.join(os.path.dirname(__file__), "..", "cup2d_tpu"))
-
-# files where ANY CUP2D_* read is a sanctioned latch:
-#   config.py — the typed-config construction point
-SANCTIONED_FILES = {"config.py"}
-
-# (file, enclosing scope) -> allowed vars. Each is a construct-once /
-# enable-once latch, grandfathered with its reason:
-SANCTIONED_SITES = {
-    # A/B gates latched per-sim in the constructor (ADVICE r5).
-    # CUP2D_POIS mode values: structured|tables|fft|fas|fas-f on the
-    # forest (AMRSim validates; fas/fas-f select the forest-native FAS
-    # full solver since PR 13), and fas|fas-f on the uniform family —
-    # the UniformGrid constructor is the ONE uniform-side latch;
-    # fleet.py and the parallel/ modules read the GRID's stored latch
-    # and stay env-read-free (this walk enforces it).
-    # CUP2D_PALLAS (PR 9): the forest's own fused-tier latch — the
-    # lab-mode megakernel dispatch in _advect_rk2 reads the stored
-    # self._kernel_tier, never the env
-    ("amr.py", "AMRSim.__init__"): {"CUP2D_POIS", "CUP2D_TWOLEVEL",
-                                    "CUP2D_PALLAS"},
-    # per-grid constructor latches (stored as self._kernel_tier /
-    # self.solver_mode+self.fas_fmg). CUP2D_PREC (PR 9) is the
-    # storage-precision contract of the fused tier: ONE read site in
-    # the whole package — fleet/mesh/bench consume the grid's stored
-    # tier string, so a mid-run env mutation can never flip the
-    # precision of a compiled step
-    ("uniform.py", "UniformGrid.__init__"): {"CUP2D_PALLAS",
-                                             "CUP2D_POIS",
-                                             "CUP2D_PREC"},
-    # the fault-injection latch (PR 7 tightened faults.py from a
-    # whole-file sanction to this one scope): every injector —
-    # including the elastic host_exit/host_hang tokens — parses from
-    # the ONE plan FaultPlan.from_env constructs; consumers (StepGuard,
-    # TopologyGuard, io's crash window) read the plan object, never the
-    # env
-    ("faults.py", "FaultPlan.from_env"): {"CUP2D_FAULTS"},
-    # read once from ShardedAMRSim.__init__, stored as self._exchange
-    ("parallel/forest_mesh.py", "_exchange_mode"):
-        {"CUP2D_SHARD_EXCHANGE"},
-    # windowed device tracing: latched once by the CLI before the run
-    # loop (a mid-run mutation must not re-arm a finished window)
-    ("profiling.py", "TraceWindow.from_env"): {"CUP2D_TRACE"},
-    # enable-once process knobs (cache paths, not numerics gates)
-    ("cache.py", "enable_compilation_cache"): {"CUP2D_CACHE"},
-    ("native/__init__.py", "_load"): {"CUP2D_NATIVE_CACHE"},
-}
-
-
-def _env_var_of(node):
-    """Return the env var name a node reads, or None. Catches
-    os.environ[...] / os.environ.get|pop|setdefault(...) / os.getenv(...)
-    (and the bare `environ`/`getenv` import-form spellings)."""
-    def is_environ(n):
-        return (isinstance(n, ast.Attribute) and n.attr == "environ") \
-            or (isinstance(n, ast.Name) and n.id == "environ")
-
-    def const(n):
-        return n.value if (isinstance(n, ast.Constant)
-                           and isinstance(n.value, str)) else "<dynamic>"
-
-    if isinstance(node, ast.Subscript) and is_environ(node.value):
-        return const(node.slice)
-    if isinstance(node, ast.Call):
-        f = node.func
-        envget = (isinstance(f, ast.Attribute)
-                  and f.attr in ("get", "pop", "setdefault")
-                  and is_environ(f.value))
-        getenv = ((isinstance(f, ast.Attribute) and f.attr == "getenv")
-                  or (isinstance(f, ast.Name) and f.id == "getenv"))
-        if envget or getenv:
-            return const(node.args[0]) if node.args else "<dynamic>"
-    return None
-
-
-def _cup2d_env_reads(path):
-    """(scope, var, lineno) for every constant CUP2D_* env read."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    out = []
-
-    def visit(node, scope):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            scope = scope + [node.name]
-        var = _env_var_of(node)
-        if var is not None and var.startswith("CUP2D_"):
-            out.append((".".join(scope) or "<module>", var, node.lineno))
-        for child in ast.iter_child_nodes(node):
-            visit(child, scope)
-
-    visit(tree, [])
-    return out
+from cup2d_tpu.analysis import lint_package, policy
 
 
 def test_cup2d_env_reads_only_at_latch_points():
-    violations = []
-    for dirpath, dirnames, filenames in os.walk(PKG):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            full = os.path.join(dirpath, fn)
-            rel = os.path.relpath(full, PKG).replace(os.sep, "/")
-            if rel in SANCTIONED_FILES:
-                continue
-            allowed_by_scope = {scope: vars_
-                                for (f, scope), vars_
-                                in SANCTIONED_SITES.items() if f == rel}
-            for scope, var, line in _cup2d_env_reads(full):
-                if var in allowed_by_scope.get(scope, ()):
-                    continue
-                violations.append(
-                    f"cup2d_tpu/{rel}:{line} reads {var} in {scope}")
-    assert not violations, (
-        "CUP2D_* env vars must be read ONCE at a sanctioned latch point "
-        "(config.py / AMRSim.__init__ / faults.py / the grandfathered "
-        "sites in tests/test_env_latch.py), never mid-run:\n  "
-        + "\n  ".join(violations))
+    report = lint_package(only=["env-latch"])
+    assert report.clean, "\n".join(str(f) for f in report.findings)
 
 
-def test_latch_allowlist_matches_reality():
-    """The sanctioned-site table must not rot: every grandfathered
-    (file, scope, var) entry still exists — a refactor that moves a
-    latch must move its allowlist row too, keeping the table an
-    accurate map of where gates live."""
-    for (rel, scope), vars_ in SANCTIONED_SITES.items():
-        reads = _cup2d_env_reads(os.path.join(PKG, rel))
-        found = {v for s, v, _ in reads if s == scope}
-        assert vars_ <= found, (
-            f"cup2d_tpu/{rel} scope {scope}: expected latched reads of "
-            f"{sorted(vars_)}, found {sorted(found)}")
+def test_latch_allowlist_matches_reality(monkeypatch):
+    # the finalize pass flags policy rows that stopped matching the
+    # code — prove it by planting a row for a latch that doesn't exist
+    bogus = dict(policy.ENV_LATCH_SITES)
+    bogus[("cache.py", "enable_compilation_cache")] = (
+        bogus[("cache.py", "enable_compilation_cache")]
+        | {"CUP2D_NO_SUCH_GATE"})
+    monkeypatch.setattr(policy, "ENV_LATCH_SITES", bogus)
+    report = lint_package(only=["env-latch"])
+    stale = [f for f in report.findings if "stale policy row" in f.message]
+    assert stale, "planted stale allowlist row was not detected"
+    assert any("CUP2D_NO_SUCH_GATE" in f.message for f in stale)
