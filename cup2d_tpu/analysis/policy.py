@@ -144,10 +144,16 @@ LEADING_DIM_SCOPES = {
     # host-side wrappers of the fused tier: normalize ANY leading shape
     # to the kernel's flat [L, ...] layout — the flattening itself must
     # not assume a rank (kernel bodies below them see fixed block
-    # shapes and are exempt by design)
+    # shapes and are exempt by design). _fused_substage_sharded rides
+    # the same flat layout from the shard_map body (ISSUE 16)
     "ops/pallas_kernels.py": ("fused_advect_heun", "fused_lab_rhs",
                               "fused_correction", "_per_member",
-                              "advect_diffuse_rhs_pallas"),
+                              "advect_diffuse_rhs_pallas",
+                              "_fused_substage_sharded"),
+    # the sharded megakernel wrapper (ISSUE 16): flattens any leading
+    # shape before entering shard_map, so fleet spatial pools (L=B) and
+    # the solo sharded sim (L=1) share one executable per BC token
+    "parallel/shard_halo.py": ("fused_advect_heun_sharded",),
 }
 
 

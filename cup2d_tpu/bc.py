@@ -147,7 +147,10 @@ class BCTable(NamedTuple):
                  "inflow": "in", "outflow": "out"}
         toks = []
         for f in self:
-            t = short[f.kind]
+            # unknown kinds pass through verbatim so diagnostics that
+            # embed the token (ops.pallas_kernels.kernel_supports) can
+            # name an unvalidated table without raising themselves
+            t = short.get(f.kind, f.kind)
             if f.kind in ("no_slip", "inflow") and any(f.u_wall):
                 u, v = f.u_wall
                 t += f"({u:g},{v:g})"

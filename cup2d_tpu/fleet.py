@@ -262,9 +262,10 @@ class FleetSim:
     @property
     def kernel_tier(self) -> str:
         """Active advection-kernel tier (telemetry schema v6) — the
-        grid's constructor latch; under spatial placement the grid
-        refuses the fused tier at construction (spmd_safe), so a
-        FleetSim that exists is always tier-consistent."""
+        grid's constructor latch, BC-token-suffixed on BC'd fused
+        tiers; under spatial placement the fused tier rides the
+        halo-mode kernel (shard_halo.fused_advect_heun_sharded) behind
+        the same latch (ISSUE 16 retired the construction refusal)."""
         return self.grid.kernel_tier
 
     @property
@@ -346,13 +347,25 @@ class FleetSim:
 
         # -- advection-diffusion, 2-stage Heun (per-member dt) --
         vel = state.vel
-        if g.kernel_tier != "xla":
-            # fused megakernel tier, member-batched: the kernel is
-            # leading-dim agnostic with a per-member (afac, dfac) row,
-            # so B members share ONE dispatch per substage
-            vel = fused_advect_heun(
-                vel, h, g.cfg.nu, dt,
-                bf16=g.kernel_tier == "pallas-fused-bf16")
+        # dispatch on the BARE tier latch: the kernel_tier property
+        # suffixes the BC token for telemetry and would never compare
+        # equal to the bare strings here
+        if g._kernel_tier != "xla":
+            bf16 = g._kernel_tier == "pallas-fused-bf16"
+            bc = None if g.bc.is_free_slip else g.bc
+            if self.placement == "spatial":
+                # spatially sharded pool: the halo-mode kernel behind
+                # the explicit ppermute exchange — one executable for
+                # all shards, still member-batched on the leading axis
+                from .parallel.shard_halo import fused_advect_heun_sharded
+                vel = fused_advect_heun_sharded(
+                    vel, h, g.cfg.nu, dt, self.mesh, bc=bc, bf16=bf16)
+            else:
+                # fused megakernel tier, member-batched: the kernel is
+                # leading-dim agnostic with a per-member (afac, dfac)
+                # row, so B members share ONE dispatch per substage
+                vel = fused_advect_heun(
+                    vel, h, g.cfg.nu, dt, bc=bc, bf16=bf16)
         else:
             vold = vel
             for c in (0.5, 1.0):
@@ -388,10 +401,15 @@ class FleetSim:
             # identity through every sweep the live members need
             b = jnp.where(active[:, None, None], b, jnp.zeros_like(b))
         res = self._pressure_solve(b, exact_poisson)
+        # bare latch again; under spatial placement the correction
+        # kernel's strip DMA cannot be GSPMD-partitioned, so the
+        # sharded pool keeps the XLA epilogue (pinned sharded==single)
+        corr_tier = ("xla" if self.placement == "spatial"
+                     else g._kernel_tier)
         vel, pres = project_correct(
             res.x, state.pres, vel, h, dt,
             spmd_safe=g.spmd_safe, mean_axes=(-2, -1),
-            tier=g.kernel_tier,
+            tier=corr_tier,
             remove_mean=g.bc.all_neumann, grad_signs=g._psigns)
         if active is not None:
             # freeze dead slots: state, diag and clock all read the

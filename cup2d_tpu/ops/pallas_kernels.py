@@ -39,9 +39,11 @@ Two generations live here:
    UniformSim (L=1), member-batched FleetSim (L=B, per-member dt), and
    — in lab form — forest-block batches (L=N, per-block h). On
    non-TPU hosts the tier runs in Pallas interpret mode (validation
-   speed, not performance); the sharded x-split path refuses the tier
-   loudly at construction (uniform.UniformGrid) instead of silently
-   computing wrong halos.
+   speed, not performance). ISSUE 16 closed the last two refusals:
+   non-free-slip BC tables ride the in-VMEM affine ghost synthesis
+   (one executable per BC token), and the sharded x-split rides the
+   halo-mode kernel (_fused_substage_sharded) behind shard_halo.
+   fused_advect_heun_sharded's ppermute-before-interior exchange.
 
    bf16 storage tier: operands stored bf16 in HBM, every VMEM
    accumulation in f32 (strips are upcast on entry, the final substage
@@ -236,37 +238,110 @@ def lab_tier_supported(dtype) -> bool:
     return HAVE_PALLAS and jnp.dtype(dtype) == jnp.float32
 
 
-def require_free_slip(bc) -> None:
-    """Kernel-tier routing guard for the per-face BC tables (bc.py):
-    every kernel in this module synthesizes FREE-SLIP wall ghosts in
-    VMEM from global row/col position — a moving-wall, inflow or
-    outflow face would be silently mirrored, computing wrong physics
-    with no diagnostic. Like the sharded-x-split case, the gap is
-    closed LOUDLY at construction; non-free-slip grids must stay on
-    the XLA chain (which routes ghosts through bc.pad_vector_bc and
-    the per-face stencil forms)."""
-    if bc is not None and not bc.is_free_slip:
-        raise ValueError(
-            "CUP2D_PALLAS=1 does not compose with a non-free-slip "
-            f"BCTable ({bc.token}): the fused kernel's in-VMEM wall-"
-            "ghost synthesis is free-slip-specific and would silently "
-            "mirror at a moving wall / inflow / outflow face. Unset "
-            "CUP2D_PALLAS for this case; it runs on the XLA tier.")
+# ghost kinds the megakernel synthesizes in VMEM (all of bc.py's
+# current vocabulary; a future kind — e.g. periodic — must be added
+# here WITH its in-kernel ghost form, or the tier refuses loudly)
+_KERNEL_BC_KINDS = ("free_slip", "no_slip", "inflow", "outflow")
 
 
-def _substage_kernel(by, n, nx, cfac, ih2, has_vold, out_dtype,
+def kernel_supports(bc) -> None:
+    """Kernel-tier capability check for the per-face BC tables (bc.py):
+    every ghost kind in bc.py's current vocabulary (free-slip mirror,
+    no-slip antireflection, Dirichlet inflow incl. the parabolic
+    profile, convective outflow) reduces to an affine combination of
+    the edge/inner lines and is synthesized in VMEM from global
+    position plus static per-face coefficients — one executable per BC
+    token, no in-kernel branching. Only a genuinely unsupported kind
+    (a future ``periodic``) refuses, loudly and naming the token, so a
+    silent wrong-physics fallback is impossible."""
+    if bc is None:
+        return
+    for name, f in zip(("x_lo", "x_hi", "y_lo", "y_hi"), bc):
+        if f.kind not in _KERNEL_BC_KINDS:
+            raise ValueError(
+                f"CUP2D_PALLAS=1: BCTable ({bc.token}) face {name} has "
+                f"kind {f.kind!r}, which has no in-VMEM ghost synthesis "
+                f"in the fused kernel (supported: "
+                f"{', '.join(_KERNEL_BC_KINDS)}). Unset CUP2D_PALLAS "
+                "for this table; it runs on the XLA tier.")
+
+
+# ---------------------------------------------------------------------------
+# in-VMEM BC ghost synthesis (tentpole, ISSUE 16): the staged twin of
+# bc.pad_vector_bc's ghost() closure. The FaceBC is STATIC at trace
+# time (the BCTable is latched per grid and already the executable's
+# hash/admit key), so each helper emits only the selected face's
+# affine arithmetic — the kernel never branches on table kind.
+# ---------------------------------------------------------------------------
+
+def _bc_uw_y(face, w, nx_tot, col0):
+    """Wall velocity (u, v) of a y face over ``w`` columns whose global
+    interior column index starts at ``col0`` (0 solo; the shard/halo
+    offset under the x-split). Mirrors bc._face_wall/_profile_1d:
+    scalars, or val * 4s(1-s) lines with s = (col + 0.5)/nx."""
+    if face.kind not in ("no_slip", "inflow"):
+        return (0.0, 0.0)
+    prof = None
+    if face.kind == "inflow" and face.profile != "uniform":
+        col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w), 2) + col0
+        s = (col.astype(jnp.float32) + 0.5) / nx_tot
+        prof = 4.0 * s * (1.0 - s)
+    return tuple((v if prof is None or v == 0.0 else v * prof)
+                 for v in face.u_wall)
+
+
+def _bc_uw_x(face, rows, row0, ny_tot):
+    """Wall velocity of an x face over ``rows`` PADDED rows starting at
+    global padded row ``row0`` (= strip_index * by; the x strips paint
+    full rows so corners compose with the y ghosts). Mirrors
+    bc._x_face_wall_padded: profile coordinates clamped to the face, so
+    a parabolic inflow closes to 0 at the wall corners."""
+    if face.kind not in ("no_slip", "inflow"):
+        return (0.0, 0.0)
+    if face.profile == "uniform" or face.kind == "no_slip":
+        return face.u_wall
+    row = jax.lax.broadcasted_iota(jnp.int32, (1, rows, 1), 1) + row0
+    s = (row.astype(jnp.float32) - _G + 0.5) / ny_tot
+    s = jnp.clip(s, 0.0, 1.0)
+    prof = 4.0 * s * (1.0 - s)
+    return tuple((v * prof if v != 0.0 else 0.0) for v in face.u_wall)
+
+
+def _bc_ghost(face, edge, inner, normal_comp, outward_sign, uw, dtf, h):
+    """One painted ghost line per bc.pad_vector_bc's ghost() closure,
+    identical arithmetic and evaluation order (the ~1-ulp equivalence
+    contract): edge/inner are [2, 1, W] (y faces) or [2, R, 1] (x
+    faces) f32 line pairs; ``uw`` the (possibly profiled) wall
+    velocity; ``dtf`` the per-member dt scalar feeding the convective-
+    outflow speed c = clip(sign * edge_n * dt/h, 0, 1)."""
+    eu, ev = edge[0:1], edge[1:2]
+    if face.kind == "free_slip":
+        return (jnp.concatenate([-eu, ev], axis=0) if normal_comp == 0
+                else jnp.concatenate([eu, -ev], axis=0))
+    if face.kind in ("no_slip", "inflow"):
+        return jnp.concatenate(
+            [2.0 * uw[0] - eu, 2.0 * uw[1] - ev], axis=0)
+    en = eu if normal_comp == 0 else ev
+    c = jnp.clip(outward_sign * en * dtf / h, 0.0, 1.0)
+    return edge + c * (edge - inner)
+
+
+def _substage_kernel(by, n, nx, cfac, ih2, has_vold, out_dtype, bc, hh,
                      facs_ref, vel_ref, *rest):
     """One Heun substage on one row strip of one batch member.
 
     Grid (L, n): batch-major, strips sequential within a member. The
     velocity is read from HBM exactly once per substage: whole strips
     (no halo overlap) DMA into a 4-slot ring; strip i's WENO halo rows
-    come from the resident strips i-1 / i+1, or from the free-slip
-    mirror ghosts synthesized in VMEM at the walls. Strip i+2
-    prefetches during strip i's compute (the double-buffering; ring of
-    4 because strips {i-1..i+2} must occupy distinct slots). The lab
-    tile is assembled as VALUES (concatenates), not scratch stores —
-    no unaligned vector stores for Mosaic to choke on."""
+    come from the resident strips i-1 / i+1, or from the wall ghosts
+    synthesized in VMEM (``bc is None``: the PR-9 free-slip mirror,
+    kept verbatim so the default table stays bit-identical; else the
+    BC'd affine ghost forms of _bc_ghost, with the per-member dt in
+    facs column 2 feeding convective outflow). Strip i+2 prefetches
+    during strip i's compute (the double-buffering; ring of 4 because
+    strips {i-1..i+2} must occupy distinct slots). The lab tile is
+    assembled as VALUES (concatenates), not scratch stores — no
+    unaligned vector stores for Mosaic to choke on."""
     if has_vold:
         vold_ref, out_ref, ring, sems, vring, vsems = rest
     else:
@@ -330,26 +405,55 @@ def _substage_kernel(by, n, nx, cfac, ih2, has_vold, out_dtype,
     # computes on the discarded operand
     prev_t = ring[_rem(i + 3, 4)][:, by - g:, :].astype(f32)
     next_h = ring[_rem(i + 1, 4)][:, :g, :].astype(f32)
-    # free-slip mirror ghosts (uniform.pad_vector, zeroth-order): all g
-    # ghost rows equal the edge row — u copied, v negated at y walls
-    top_m = jnp.concatenate(
-        [cur[0:1, 0:1, :], -cur[1:2, 0:1, :]], axis=0)
-    bot_m = jnp.concatenate(
-        [cur[0:1, by - 1:by, :], -cur[1:2, by - 1:by, :]], axis=0)
-    top = jnp.where(i > 0, prev_t, jnp.broadcast_to(top_m, (2, g, nx)))
-    bot = jnp.where(i + 1 < n, next_h,
-                    jnp.broadcast_to(bot_m, (2, g, nx)))
-    ycol = jnp.concatenate([top, cur, bot], axis=1)         # [2, by+6, nx]
-    # x ghosts read the y-completed columns so corners compose both
-    # flips, exactly like pad_vector's two-pass sweep: u negated,
-    # v copied at x walls
-    left = jnp.concatenate(
-        [-ycol[0:1, :, 0:1], ycol[1:2, :, 0:1]], axis=0)
-    right = jnp.concatenate(
-        [-ycol[0:1, :, nx - 1:nx], ycol[1:2, :, nx - 1:nx]], axis=0)
-    lab = jnp.concatenate(
-        [jnp.broadcast_to(left, (2, by + 2 * g, g)), ycol,
-         jnp.broadcast_to(right, (2, by + 2 * g, g))], axis=2)
+    if bc is None:
+        # free-slip mirror ghosts (uniform.pad_vector, zeroth-order):
+        # all g ghost rows equal the edge row — u copied, v negated at
+        # y walls. PR-9 path, verbatim (bit-identity contract).
+        top_m = jnp.concatenate(
+            [cur[0:1, 0:1, :], -cur[1:2, 0:1, :]], axis=0)
+        bot_m = jnp.concatenate(
+            [cur[0:1, by - 1:by, :], -cur[1:2, by - 1:by, :]], axis=0)
+        top = jnp.where(i > 0, prev_t,
+                        jnp.broadcast_to(top_m, (2, g, nx)))
+        bot = jnp.where(i + 1 < n, next_h,
+                        jnp.broadcast_to(bot_m, (2, g, nx)))
+        ycol = jnp.concatenate([top, cur, bot], axis=1)     # [2, by+6, nx]
+        # x ghosts read the y-completed columns so corners compose both
+        # flips, exactly like pad_vector's two-pass sweep: u negated,
+        # v copied at x walls
+        left = jnp.concatenate(
+            [-ycol[0:1, :, 0:1], ycol[1:2, :, 0:1]], axis=0)
+        right = jnp.concatenate(
+            [-ycol[0:1, :, nx - 1:nx], ycol[1:2, :, nx - 1:nx]], axis=0)
+        lab = jnp.concatenate(
+            [jnp.broadcast_to(left, (2, by + 2 * g, g)), ycol,
+             jnp.broadcast_to(right, (2, by + 2 * g, g))], axis=2)
+    else:
+        # BC'd wall ghosts (bc.pad_vector_bc, staged): y faces first
+        # over interior columns, then x faces over the y-completed
+        # columns so corners compose in the same order
+        dtf = facs_ref[l, 2]
+        glo = _bc_ghost(bc.y_lo, cur[:, 0:1, :], cur[:, 1:2, :],
+                        1, -1.0, _bc_uw_y(bc.y_lo, nx, nx, 0), dtf, hh)
+        ghi = _bc_ghost(bc.y_hi, cur[:, by - 1:by, :],
+                        cur[:, by - 2:by - 1, :],
+                        1, 1.0, _bc_uw_y(bc.y_hi, nx, nx, 0), dtf, hh)
+        top = jnp.where(i > 0, prev_t,
+                        jnp.broadcast_to(glo, (2, g, nx)))
+        bot = jnp.where(i + 1 < n, next_h,
+                        jnp.broadcast_to(ghi, (2, g, nx)))
+        ycol = jnp.concatenate([top, cur, bot], axis=1)     # [2, by+6, nx]
+        rows = by + 2 * g
+        gl = _bc_ghost(bc.x_lo, ycol[:, :, 0:1], ycol[:, :, 1:2],
+                       0, -1.0, _bc_uw_x(bc.x_lo, rows, i * by, n * by),
+                       dtf, hh)
+        gr = _bc_ghost(bc.x_hi, ycol[:, :, nx - 1:nx],
+                       ycol[:, :, nx - 2:nx - 1],
+                       0, 1.0, _bc_uw_x(bc.x_hi, rows, i * by, n * by),
+                       dtf, hh)
+        lab = jnp.concatenate(
+            [jnp.broadcast_to(gl, (2, rows, g)), ycol,
+             jnp.broadcast_to(gr, (2, rows, g))], axis=2)
 
     af = facs_ref[l, 0]
     df = facs_ref[l, 1]
@@ -361,17 +465,20 @@ def _substage_kernel(by, n, nx, cfac, ih2, has_vold, out_dtype,
     out_ref[0] = heun_substage(vold, cfac, rhs, ih2).astype(out_dtype)
 
 
-def _fused_substage(v, vold, facs, cfac, ih2, out_dtype, interpret):
+def _fused_substage(v, vold, facs, cfac, ih2, out_dtype, interpret,
+                    bc=None, hh=None):
     """One megakernel substage over flattened operands.
     v: [L, 2, ny, nx] (storage dtype); vold: same or None (substage 1,
     where vold==vel and the ring strip is reused); facs: [L, 2] f32
-    (afac, dfac) per batch member."""
+    (afac, dfac) per batch member, widened to [L, 3] with the raw dt
+    in column 2 when a BC table rides along."""
     L, _, ny, nx = v.shape
     by = _BY_BF16 if v.dtype == jnp.bfloat16 else _BY_F32
     n = ny // by
     has_vold = vold is not None
     kern = functools.partial(_substage_kernel, by, n, nx,
-                             cfac, ih2, has_vold, jnp.dtype(out_dtype))
+                             cfac, ih2, has_vold, jnp.dtype(out_dtype),
+                             bc, hh)
     in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM),
                 pl.BlockSpec(memory_space=pltpu.ANY)]
     ops = [facs, v]
@@ -411,7 +518,7 @@ def _per_member(x, lead, L, dtype=jnp.float32):
     return jnp.broadcast_to(x, lead).reshape((L,))
 
 
-def fused_advect_heun(vel, h, nu, dt, *, bf16: bool = False,
+def fused_advect_heun(vel, h, nu, dt, *, bc=None, bf16: bool = False,
                       interpret=None):
     """Both Heun substages through the fused megakernel — the drop-in
     tier for ``UniformGrid.advect_heun`` and the fleet's inlined chain.
@@ -422,24 +529,223 @@ def fused_advect_heun(vel, h, nu, dt, *, bf16: bool = False,
     deviation source is compiler FMA contraction, bound asserted in
     tests/test_megakernel.py). bf16: storage-precision tier — one
     upcast-free bf16 read per substage, f32 VMEM accumulation, f32
-    final state."""
+    final state. bc: optional BCTable (bc.py); a free-slip table is
+    normalized to None so the default path stays bit-identical to
+    PR 9, any other table rides the in-VMEM BC ghost synthesis (one
+    executable per token — the FaceBCs are static trace constants)."""
+    if bc is not None and bc.is_free_slip:
+        bc = None
+    kernel_supports(bc)
     lead = vel.shape[:-3]
     L = _flatten_lead(lead)
     v = vel.reshape((L,) + vel.shape[-3:])
     dtv = _per_member(dt, lead, L)
-    facs = jnp.stack([-dtv * h, nu * dtv], axis=-1)         # [L, 2] f32
-    ih2 = 1.0 / (h * h)
+    hh = float(h)
+    if bc is None:
+        facs = jnp.stack([-dtv * hh, nu * dtv], axis=-1)    # [L, 2] f32
+    else:
+        # raw per-member dt rides column 2 (convective-outflow speed)
+        facs = jnp.stack([-dtv * hh, nu * dtv, dtv], axis=-1)
+    ih2 = 1.0 / (hh * hh)
     if interpret is None:
         interpret = not _on_accel()
     if bf16:
         vb = v.astype(jnp.bfloat16)
         v1 = _fused_substage(vb, None, facs, 0.5, ih2,
-                             jnp.bfloat16, interpret)
-        v2 = _fused_substage(v1, vb, facs, 1.0, ih2, v.dtype, interpret)
+                             jnp.bfloat16, interpret, bc, hh)
+        v2 = _fused_substage(v1, vb, facs, 1.0, ih2, v.dtype, interpret,
+                             bc, hh)
     else:
-        v1 = _fused_substage(v, None, facs, 0.5, ih2, v.dtype, interpret)
-        v2 = _fused_substage(v1, v, facs, 1.0, ih2, v.dtype, interpret)
+        v1 = _fused_substage(v, None, facs, 0.5, ih2, v.dtype,
+                             interpret, bc, hh)
+        v2 = _fused_substage(v1, v, facs, 1.0, ih2, v.dtype, interpret,
+                             bc, hh)
     return v2.reshape(vel.shape)
+
+
+# ---------------------------------------------------------------------------
+# sharded-x-split substage (tentpole, ISSUE 16): the halo-mode twin of
+# _substage_kernel. The shard_map wrapper (parallel/shard_halo.
+# fused_advect_heun_sharded) ppermutes the 3-wide WENO edge columns
+# BEFORE dispatching this kernel, so the exchange latency hides behind
+# the strip pipeline; the received columns arrive as a lane-padded
+# ``aux`` operand and are fused as the boundary strips' ghost source —
+# termwise-identical to the GSPMD chain.
+# ---------------------------------------------------------------------------
+
+def _sharded_substage_kernel(by, n, nxl, nx_tot, cfac, ih2, has_vold,
+                             out_dtype, bc, hh, facs_ref, info_ref,
+                             vel_ref, aux_ref, *rest):
+    """Per-shard substage over the local x slab [2, ny, nxl].
+
+    aux: [L, 2, ny, 2*_GX] halo operand — received left-neighbor edge
+    columns in [:, :, :, 0:g], right-neighbor in [g:2g], zero elsewhere
+    (lane padding, and zeros at the mesh walls where no neighbor
+    sends). info (SMEM i32 [1, 3]): (is_lo, is_hi, col0 = idx*nxl) from
+    the traced axis index — the ONLY per-shard values; everything else
+    is static, so all shards share one executable. Both rings follow
+    the solo kernel's exactly-once DMA discipline; the extended strip
+    (halo + local + halo) runs the y-ghost pass at global column
+    coordinates, then wall shards where-select the x-face BC paint over
+    the (zero) non-received halo columns — the same corner composition
+    order as bc.pad_vector_bc."""
+    if has_vold:
+        vold_ref, out_ref, ring, sems, aring, asems, vring, vsems = rest
+    else:
+        out_ref, ring, sems, aring, asems = rest
+
+    l = pl.program_id(0)
+    i = pl.program_id(1)
+    g = _G
+
+    def dma(k):
+        slot = _rem(k, 4)
+        return pltpu.make_async_copy(
+            vel_ref.at[l, :, pl.ds(k * by, by), :],
+            ring.at[slot], sems.at[slot])
+
+    def adma(k):
+        slot = _rem(k, 4)
+        return pltpu.make_async_copy(
+            aux_ref.at[l, :, pl.ds(k * by, by), :],
+            aring.at[slot], asems.at[slot])
+
+    @pl.when(i == 0)
+    def _():
+        dma(0).start()
+        adma(0).start()
+        if n > 1:
+            dma(1).start()
+            adma(1).start()
+
+    @pl.when(i + 2 < n)
+    def _():
+        dma(i + 2).start()
+        adma(i + 2).start()
+
+    if has_vold:
+        def vdma(k):
+            slot = _rem(k, 2)
+            return pltpu.make_async_copy(
+                vold_ref.at[l, :, pl.ds(k * by, by), :],
+                vring.at[slot], vsems.at[slot])
+
+        @pl.when(i == 0)
+        def _():
+            vdma(0).start()
+
+        @pl.when(i + 1 < n)
+        def _():
+            vdma(i + 1).start()
+
+    @pl.when(i == 0)
+    def _():
+        dma(0).wait()
+        adma(0).wait()
+        if n > 1:
+            dma(1).wait()
+            adma(1).wait()
+
+    @pl.when((i > 0) & (i + 1 < n))
+    def _():
+        dma(i + 1).wait()
+        adma(i + 1).wait()
+
+    if has_vold:
+        vdma(i).wait()
+
+    f32 = jnp.float32
+    is_lo = info_ref[0, 0]
+    is_hi = info_ref[0, 1]
+    col0 = info_ref[0, 2]
+    we = nxl + 2 * g
+
+    def ext(k, rows):
+        """Extended-width rows of strip k: received halo columns glued
+        onto the local slab (value concatenate, f32 upcast)."""
+        a = aring[_rem(k, 4)][:, rows, :].astype(f32)
+        v = ring[_rem(k, 4)][:, rows, :].astype(f32)
+        return jnp.concatenate(
+            [a[:, :, 0:g], v, a[:, :, g:2 * g]], axis=2)
+
+    cur = ext(i, slice(None))                        # [2, by, we]
+    prev_t = ext(i + 3, slice(by - g, by))
+    next_h = ext(i + 1, slice(0, g))
+    dtf = facs_ref[l, 2]
+    # y ghosts over the EXTENDED width at global column coordinates:
+    # halo columns get the same paint the neighbor's own y pass gives
+    # them (identical formula, identical edge/inner data); the unsent
+    # wall-shard halo columns are junk here and are overwritten by the
+    # x-face where-select below — corners compose in bc.py's order
+    glo = _bc_ghost(bc.y_lo, cur[:, 0:1, :], cur[:, 1:2, :],
+                    1, -1.0, _bc_uw_y(bc.y_lo, we, nx_tot, col0 - g),
+                    dtf, hh)
+    ghi = _bc_ghost(bc.y_hi, cur[:, by - 1:by, :], cur[:, by - 2:by - 1, :],
+                    1, 1.0, _bc_uw_y(bc.y_hi, we, nx_tot, col0 - g),
+                    dtf, hh)
+    top = jnp.where(i > 0, prev_t, jnp.broadcast_to(glo, (2, g, we)))
+    bot = jnp.where(i + 1 < n, next_h,
+                    jnp.broadcast_to(ghi, (2, g, we)))
+    ycol = jnp.concatenate([top, cur, bot], axis=1)  # [2, by+2g, we]
+    rows = by + 2 * g
+    gl = _bc_ghost(bc.x_lo, ycol[:, :, g:g + 1], ycol[:, :, g + 1:g + 2],
+                   0, -1.0, _bc_uw_x(bc.x_lo, rows, i * by, n * by),
+                   dtf, hh)
+    gr = _bc_ghost(bc.x_hi, ycol[:, :, g + nxl - 1:g + nxl],
+                   ycol[:, :, g + nxl - 2:g + nxl - 1],
+                   0, 1.0, _bc_uw_x(bc.x_hi, rows, i * by, n * by),
+                   dtf, hh)
+    left = jnp.where(is_lo > 0, jnp.broadcast_to(gl, (2, rows, g)),
+                     ycol[:, :, 0:g])
+    right = jnp.where(is_hi > 0, jnp.broadcast_to(gr, (2, rows, g)),
+                      ycol[:, :, g + nxl:])
+    lab = jnp.concatenate([left, ycol[:, :, g:g + nxl], right], axis=2)
+
+    af = facs_ref[l, 0]
+    df = facs_ref[l, 1]
+    rhs = _core_seq(lab, af, df)
+    if has_vold:
+        vold = vring[_rem(i, 2)].astype(f32)
+    else:
+        vold = cur[:, :, g:g + nxl]
+    out_ref[0] = heun_substage(vold, cfac, rhs, ih2).astype(out_dtype)
+
+
+def _fused_substage_sharded(v, vold, aux, info, facs, cfac, ih2,
+                            out_dtype, bc, hh, nx_tot, interpret):
+    """One halo-mode substage over flattened per-shard operands.
+    v: [L, 2, ny, nxl]; aux: [L, 2, ny, 2*_GX] received halo columns;
+    info: [1, 3] i32 (is_lo, is_hi, col0); facs: [L, 3]."""
+    L, _, ny, nxl = v.shape
+    by = _BY_BF16 if v.dtype == jnp.bfloat16 else _BY_F32
+    n = ny // by
+    has_vold = vold is not None
+    kern = functools.partial(_sharded_substage_kernel, by, n, nxl,
+                             nx_tot, cfac, ih2, has_vold,
+                             jnp.dtype(out_dtype), bc, hh)
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY)]
+    ops = [facs, info, v, aux]
+    scratch = [pltpu.VMEM((4, 2, by, nxl), v.dtype),
+               pltpu.SemaphoreType.DMA((4,)),
+               pltpu.VMEM((4, 2, by, 2 * _GX), aux.dtype),
+               pltpu.SemaphoreType.DMA((4,))]
+    if has_vold:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        ops.append(vold)
+        scratch += [pltpu.VMEM((2, 2, by, nxl), vold.dtype),
+                    pltpu.SemaphoreType.DMA((2,))]
+    return pl.pallas_call(
+        kern,
+        grid=(L, n),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 2, by, nxl), lambda l, i: (l, 0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((L, 2, ny, nxl), out_dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*ops)
 
 
 # ---------------------------------------------------------------------------
@@ -495,8 +801,8 @@ def fused_lab_rhs(lab, h, nu, dt, *, interpret=None):
 # chain's separate passes (poisson.project_correct dispatches here)
 # ---------------------------------------------------------------------------
 
-def _correct_kernel(by, n, ny, nx, ih2, scal_ref, x_ref, p_ref, v_ref,
-                    pres_out, vel_out, xr, xs, pr, ps, vr, vs):
+def _correct_kernel(by, n, ny, nx, ih2, gs, scal_ref, x_ref, p_ref,
+                    v_ref, pres_out, vel_out, xr, xs, pr, ps, vr, vs):
     l = pl.program_id(0)
     i = pl.program_id(1)
 
@@ -570,15 +876,21 @@ def _correct_kernel(by, n, ny, nx, ih2, scal_ref, x_ref, p_ref, v_ref,
     pcol = jnp.concatenate([top, cur, bot], axis=0)         # [by+2, nx]
     z = jnp.zeros((by + 2, 1), f32)
     pw = jnp.concatenate([z, pcol, z], axis=1)              # [by+2, nx+2]
-    # rank-1 Neumann edge corrections from GLOBAL indices
-    # (stencil._edge_ones values; 2-D iota — Mosaic has no 1-D iota)
+    # rank-1 BC edge corrections from GLOBAL indices: -s at the low
+    # wall, +s at the high wall with s the per-face pressure-row sign
+    # (bc.BCTable.pressure_signs; +1 Neumann, -1 Dirichlet outflow) —
+    # gs=(1,1,1,1) reproduces stencil._edge_ones' Neumann constants
+    # bitwise (2-D iota — Mosaic has no 1-D iota)
+    sx_lo, sx_hi, sy_lo, sy_hi = gs
     col = jax.lax.broadcasted_iota(jnp.int32, (by, nx), 1)
     row = jax.lax.broadcasted_iota(jnp.int32, (by, nx), 0) + i * by
     zero = jnp.zeros((), f32)
-    gx = jnp.where(col == 0, jnp.asarray(-1.0, f32),
-                   jnp.where(col == nx - 1, jnp.asarray(1.0, f32), zero))
-    gy = jnp.where(row == 0, jnp.asarray(-1.0, f32),
-                   jnp.where(row == ny - 1, jnp.asarray(1.0, f32), zero))
+    gx = jnp.where(col == 0, jnp.asarray(-sx_lo, f32),
+                   jnp.where(col == nx - 1, jnp.asarray(sx_hi, f32),
+                             zero))
+    gy = jnp.where(row == 0, jnp.asarray(-sy_lo, f32),
+                   jnp.where(row == ny - 1, jnp.asarray(sy_hi, f32),
+                             zero))
     dpx = (pw[1:-1, 2:] - pw[1:-1, :-2]) + cur * gx
     dpy = (pw[2:, 1:-1] - pw[:-2, 1:-1]) + cur * gy
     dv_ = pfac * jnp.stack([dpx, dpy], axis=0)              # [2, by, nx]
@@ -587,16 +899,21 @@ def _correct_kernel(by, n, ny, nx, ih2, scal_ref, x_ref, p_ref, v_ref,
 
 
 def fused_correction(x, pres_old, vel, mx, mp, pfac, ih2, *,
-                     interpret=None):
+                     grad_signs=None, interpret=None):
     """x, pres_old: [L, Ny, Nx]; vel: [L, 2, Ny, Nx]; mx/mp/pfac: [L]
-    (means and -0.5*dt*h per batch member). Returns (pres, vel)."""
+    (means and -0.5*dt*h per batch member). grad_signs: optional
+    static (sx_lo, sx_hi, sy_lo, sy_hi) per-face pressure-row signs
+    (bc.BCTable.pressure_signs); None = all-Neumann, bit-identical to
+    the PR-9 kernel. Returns (pres, vel)."""
     L, ny, nx = x.shape
     by = _BY_F32
     n = ny // by
     if interpret is None:
         interpret = not _on_accel()
+    gs = ((1.0, 1.0, 1.0, 1.0) if grad_signs is None
+          else tuple(float(s) for s in grad_signs))
     scal = jnp.stack([mx, mp, pfac], axis=-1).astype(jnp.float32)
-    kern = functools.partial(_correct_kernel, by, n, ny, nx, ih2)
+    kern = functools.partial(_correct_kernel, by, n, ny, nx, ih2, gs)
     f32 = jnp.float32
     return pl.pallas_call(
         kern,

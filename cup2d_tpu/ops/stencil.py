@@ -14,6 +14,13 @@ last two axes, i.e. shape `[..., Ny + 2g, Nx + 2g]`; kernels return interior
 arrays `[..., Ny, Nx]`. Axis -2 is y, axis -1 is x. Velocity labs carry a
 leading component axis of size 2 (u, v). "Undivided" differences (no 1/h)
 are used where the reference uses them, so scalings match exactly.
+
+This library is also the fused Pallas tier's semantic reference: the
+megakernel (ops/pallas_kernels.py) reuses these op bodies over VMEM
+strips and, since ISSUE 16, synthesizes every bc.py ghost kind in-VMEM
+from the same affine edge/inner-line combinations the XLA chain paints
+via pad_vector_bc — the pad -> advect_diffuse_rhs -> heun_substage
+composition below is what the kernel equivalence tests pin against.
 """
 
 from __future__ import annotations

@@ -97,13 +97,16 @@ class ShardedUniformSim(UniformSim):
                 f"{mesh.devices.size}"
             )
         self.mesh = mesh
-        # FAS solve path (CUP2D_POIS=fas, latched in UniformGrid):
-        # rebuild the MG hierarchy mesh-aware so its finest-level
-        # smoothing sweeps run the comm/compute-overlapped shard_map
-        # form (shard_halo.overlap_jacobi_sweeps) instead of leaving
-        # the halo schedule to GSPMD. Must happen BEFORE the step
-        # re-jit below so the compiled step captures the overlapped
-        # smoother. No-op on the default Krylov path.
+        # Point the grid at the mesh BEFORE the step re-jit below so
+        # the compiled step captures the mesh-aware forms: the fused
+        # advection tier (CUP2D_PALLAS=1) dispatches through the
+        # halo-mode megakernel (shard_halo.fused_advect_heun_sharded,
+        # edge-column ppermutes issued before the strip pipeline), and
+        # the FAS solve path (CUP2D_POIS=fas) rebuilds its MG
+        # hierarchy so the finest-level smoothing sweeps run the
+        # comm/compute-overlapped shard_map form
+        # (shard_halo.overlap_jacobi_sweeps) instead of leaving the
+        # halo schedule to GSPMD.
         self.grid.attach_mesh(mesh)
         state_shardings = FlowState(
             vel=NamedSharding(mesh, vector_spec()),

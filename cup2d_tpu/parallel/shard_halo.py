@@ -587,6 +587,97 @@ def overlap_jacobi_sweeps(e: jnp.ndarray, r: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# comm/compute-overlapped megakernel substages on x-split velocity
+# (tentpole, ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def fused_advect_heun_sharded(vel, h, nu, dt, mesh: Mesh, *, bc=None,
+                              bf16: bool = False, interpret=None):
+    """Both Heun substages of the fused megakernel tier on an x-split
+    velocity — the mesh-aware twin of
+    ``ops.pallas_kernels.fused_advect_heun``.
+
+    Each substage ISSUES the two 3-wide edge-column ``lax.ppermute``s
+    for the WENO halo FIRST (the ``overlap_jacobi_sweeps`` idiom,
+    arXiv:1309.7128 — boundary devices receive zeros), then dispatches
+    the interior strip pipeline; the received columns are fused inside
+    the kernel as the boundary strips' ghost source
+    (``_fused_substage_sharded``), so the exchange latency hides behind
+    the kernel body. The halo is exchanged in the STORAGE dtype (bf16
+    on the bf16 tier) — exactly the columns the solo kernel reads from
+    its own ring — so the sharded trajectory is termwise-identical to
+    the GSPMD chain. The BCTable (default free-slip) is static; wall
+    shards where-select the x-face ghost paint over the non-received
+    halo columns, interior shards never branch.
+
+    vel: [..., 2, Ny, Nx] with Nx divisible by the mesh size; dt:
+    scalar or leading-shaped (per-member). Returns the substage-2
+    velocity in vel's shape/dtype."""
+    from ..bc import BCTable
+    from ..ops import pallas_kernels as pk
+
+    if bc is None or bc.is_free_slip:
+        bc = BCTable()
+    pk.kernel_supports(bc)
+    lead = vel.shape[:-3]
+    L = pk._flatten_lead(lead)
+    v = vel.reshape((L,) + vel.shape[-3:])
+    dtv = pk._per_member(dt, lead, L)
+    hh = float(h)
+    facs = jnp.stack([-dtv * hh, nu * dtv, dtv], axis=-1)   # [L, 3] f32
+    ih2 = 1.0 / (hh * hh)
+    if interpret is None:
+        interpret = not pk._on_accel()
+    D = int(mesh.devices.size)
+    nx = v.shape[-1]
+    if nx % D:
+        raise ValueError(
+            f"fused_advect_heun_sharded: Nx={nx} not divisible by the "
+            f"mesh size D={D}")
+    nxl = nx // D
+    g = pk._G
+    pad_w = 2 * pk._GX - 2 * g   # halo operand lane-padded to 128
+
+    # check_rep=False: shard_map has no replication rule for
+    # pallas_call; every output is explicitly sharded on "x" anyway
+    @partial(_shard_map, mesh=mesh,
+             in_specs=(P(None, None, None, "x"), P(None, None)),
+             out_specs=P(None, None, None, "x"), check_rep=False)
+    def run(vb, facsb):
+        idx = jax.lax.axis_index("x")
+        i32 = jnp.int32
+        info = jnp.stack([(idx == 0).astype(i32),
+                          (idx == D - 1).astype(i32),
+                          (idx * nxl).astype(i32)])[None, :]
+
+        def halo(a):
+            # exchange first (storage dtype): my left halo is my left
+            # neighbor's last g columns, my right halo the right
+            # neighbor's first g; wall devices receive zeros (replaced
+            # in-kernel by the x-face BC paint)
+            hl = jax.lax.ppermute(
+                a[..., -g:], "x", perm=[(d, d + 1) for d in range(D - 1)])
+            hr = jax.lax.ppermute(
+                a[..., :g], "x", perm=[(d + 1, d) for d in range(D - 1)])
+            aux = jnp.concatenate([hl, hr], axis=-1)        # [L,2,ny,2g]
+            return jnp.pad(aux, ((0, 0), (0, 0), (0, 0), (0, pad_w)))
+
+        def sub(stage_v, vold, cfac, out_dtype):
+            return pk._fused_substage_sharded(
+                stage_v, vold, halo(stage_v), info, facsb, cfac, ih2,
+                out_dtype, bc, hh, nx, interpret)
+
+        if bf16:
+            v0 = vb.astype(jnp.bfloat16)
+            v1 = sub(v0, None, 0.5, jnp.bfloat16)
+            return sub(v1, v0, 1.0, vb.dtype)
+        v1 = sub(vb, None, 0.5, vb.dtype)
+        return sub(v1, vb, 1.0, vb.dtype)
+
+    return run(v, facs).reshape(vel.shape)
+
+
+# ---------------------------------------------------------------------------
 # structured per-face Poisson operator across shards (round 5 on the mesh)
 # ---------------------------------------------------------------------------
 

@@ -952,11 +952,14 @@ def project_correct(x, pres_old, vel, h, dt, *, spmd_safe=False,
     outflow face carry a Dirichlet pressure row, the operator is
     non-singular and the mean subtraction would shift the anchored
     level — the epilogue then uses dp/pres as-is. ``grad_signs`` is
-    the table's (sx_lo, sx_hi, sy_lo, sy_hi) pressure-ghost sign tuple
-    feeding pressure_gradient_update_bc; None keeps the legacy
-    all-Neumann gradient verbatim. Non-default tables never reach the
-    Pallas tier (UniformGrid refuses at construction), so only the XLA
-    branch carries them.
+    the table's (sx_lo, sx_hi, sy_lo, sy_hi) pressure-ghost sign tuple;
+    None keeps the legacy all-Neumann gradient verbatim. BOTH branches
+    carry it (ISSUE 16): the XLA chain routes it to
+    pressure_gradient_update_bc, the fused kernel bakes the static
+    signs into its rank-1 edge correction (the all-Neumann default is
+    bit-identical to the PR-9 kernel — mean subtraction of the zeros
+    mx/mp is the identity, and gs=(1,1,1,1) reproduces the hard-coded
+    edge constants).
 
     Returns (vel, pres).
     """
@@ -988,7 +991,8 @@ def project_correct(x, pres_old, vel, h, dt, *, spmd_safe=False,
         pres, velc = fused_correction(
             x.reshape((L, ny, nx)), pres_old.reshape((L, ny, nx)),
             vel.reshape((L, 2, ny, nx)),
-            flat(mx), flat(mp), -0.5 * dtv * h, ih2)
+            flat(mx), flat(mp), -0.5 * dtv * h, ih2,
+            grad_signs=grad_signs)
         return velc.reshape(vel.shape), pres.reshape(x.shape)
     dt_b = dt[:, None, None, None] if jnp.ndim(dt) == 1 else dt
     if not remove_mean:

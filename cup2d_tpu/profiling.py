@@ -371,7 +371,12 @@ METRICS_KEYS = (
     # kernel tier latch (drivers' .kernel_tier — xla | pallas-fused |
     # pallas-fused-bf16) and the hot-loop storage-precision contract
     # (.prec_mode — f32|f64|bf16), so a kernel-tier A/B run is
-    # attributable from metrics.jsonl alone, like poisson_mode
+    # attributable from metrics.jsonl alone, like poisson_mode. The
+    # VALUE vocabulary grew without a schema bump (ISSUE 16, no keys
+    # moved): BC'd fused tiers suffix the per-face token — e.g.
+    # "pallas-fused+bc(in(1,0)[parabolic],out,fs,fs)" — captured at
+    # dispatch through the _Pending lagged-commit rule like
+    # poisson_mode
     "kernel_tier", "prec_mode",
     # boundary-condition attribution (schema v8, ISSUE 12): the
     # driver's compact per-face BCTable token string (.bc_table — e.g.

@@ -452,10 +452,10 @@ class _Pending:
     """One dispatched-but-unverdicted step (the lagged slot)."""
 
     __slots__ = ("step0", "t0", "diag", "exact", "dt_host", "advanced",
-                 "snap", "trig", "fired", "mode")
+                 "snap", "trig", "fired", "mode", "tier")
 
     def __init__(self, step0, t0, diag, exact, dt_host, advanced,
-                 snap=None, trig=None, fired=(), mode=None):
+                 snap=None, trig=None, fired=(), mode=None, tier=None):
         self.step0 = step0
         self.t0 = t0
         self.diag = diag
@@ -470,6 +470,10 @@ class _Pending:
         #                              with the path N actually TOOK, not
         #                              the live mode after N+1's dispatch
         #                              may have flipped the trigger
+        self.tier = tier             # sim.kernel_tier at dispatch (v6/
+        #                              ISSUE 16): BC-token-suffixed tier
+        #                              string, captured under the same
+        #                              lagged-commit rule as mode
 
 
 class StepGuard:
@@ -680,7 +684,8 @@ class StepGuard:
             dt_host=(sim.time - t0 if sim.time != t0 else None),
             advanced=(sim.time != t0), trig=trig,
             fired=self._last_fired,
-            mode=getattr(sim, "poisson_mode", None))
+            mode=getattr(sim, "poisson_mode", None),
+            tier=getattr(sim, "kernel_tier", None))
         # optimistic cadence snapshot: the post-step state must be
         # copied BEFORE the next dispatch donates its buffers; if this
         # step's lagged verdict comes back bad, the copy is discarded
@@ -756,6 +761,10 @@ class StepGuard:
             # recorder prefers this over the live sim property, which
             # may already reflect a later dispatch's trigger flip
             rec["poisson_mode"] = pend.mode
+        if pend.tier is not None:
+            # dispatch-time kernel-tier label (BC-token-suffixed,
+            # ISSUE 16), same lagged-commit rule
+            rec["kernel_tier"] = pend.tier
         return rec
 
     def _verdict_from(self, vals: dict, step: int) -> StepVerdict:
@@ -1202,6 +1211,8 @@ class FleetStepGuard(StepGuard):
                "t": sim.time, "dt": dts}
         if pend.mode is not None:
             rec["poisson_mode"] = pend.mode   # dispatch-time label
+        if pend.tier is not None:
+            rec["kernel_tier"] = pend.tier    # dispatch-time label
         return rec
 
     # -- per-member recovery ------------------------------------------

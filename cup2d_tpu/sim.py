@@ -138,7 +138,11 @@ class Simulation(ShapeHostMixin):
 
     @property
     def kernel_tier(self) -> str:
-        """Active advection-kernel tier (telemetry schema v6)."""
+        """Active advection-kernel tier (telemetry schema v6). Since
+        ISSUE 16 the value vocabulary carries a BC-token suffix on
+        non-default tables — "pallas-fused+bc(<token>)" — so merged
+        fleet streams attribute each record to the executable (one per
+        table) that produced it; the schema key set is unchanged."""
         return self.grid.kernel_tier
 
     @property

@@ -169,23 +169,15 @@ class UniformGrid:
                 " or drop CUP2D_PREC")
         tier = "xla"
         if use_pallas:
-            if spmd_safe:
-                # composition gap closed LOUDLY (ISSUE 9): the strip
-                # kernel synthesizes free-slip wall ghosts from global
-                # row/col position — under the sharded x-split each
-                # shard would mirror at an interior halo seam and
-                # silently compute wrong physics. Refuse at
-                # construction; the sharded path keeps its XLA chain.
-                raise ValueError(
-                    "CUP2D_PALLAS=1 does not compose with the sharded "
-                    "x-split (spmd_safe=True): the fused kernel's wall-"
-                    "ghost synthesis is global, not shard-local. Unset "
-                    "CUP2D_PALLAS for sharded runs.")
-            # same construction-time loudness for the per-face BC
-            # composition gap: the kernel's VMEM ghost synthesis is
-            # free-slip-specific (ops/pallas_kernels.require_free_slip)
-            from .ops.pallas_kernels import require_free_slip
-            require_free_slip(self.bc)
+            # capability check (ISSUE 16 retired the two construction
+            # refusals): every bc.py ghost kind now has an in-VMEM
+            # synthesis, and the sharded x-split routes through the
+            # halo-mode kernel (shard_halo.fused_advect_heun_sharded,
+            # dispatched in advect_heun once a mesh is attached) — only
+            # a genuinely unsupported future kind refuses, loudly and
+            # naming the token.
+            from .ops.pallas_kernels import kernel_supports
+            kernel_supports(self.bc)
             ny = cfg.bpdy * cfg.bs << lvl
             nx = cfg.bpdx * cfg.bs << lvl
             from .ops.pallas_kernels import fused_tier_supported
@@ -203,6 +195,9 @@ class UniformGrid:
             # fallback (the tier is an optimization, not a semantic)
         self._kernel_tier = tier
         self.use_pallas = tier != "xla"   # back-compat bool alias
+        # device mesh of the sharded x-split (attach_mesh): routes the
+        # fused tier through the halo-mode kernel wrapper
+        self._mesh = None
         # Poisson solve-path latch (read ONCE here, the AMRSim.__init__
         # pattern — tests/test_env_latch.py sanctions this site): the
         # uniform/fleet/sharded-uniform drivers accept "fas"/"fas-f"
@@ -351,7 +346,13 @@ class UniformGrid:
     @property
     def kernel_tier(self) -> str:
         """Active advection-kernel tier latch (telemetry schema v6):
-        xla | pallas-fused | pallas-fused-bf16."""
+        xla | pallas-fused | pallas-fused-bf16, with the BC token
+        suffixed on BC'd fused tiers (ISSUE 16, e.g.
+        ``pallas-fused+bc(in,out,fs,fs)``) — the suffix IS the
+        executable identity (one compile per token). Internal
+        dispatch compares the bare ``_kernel_tier`` latch."""
+        if self._kernel_tier != "xla" and not self.bc.is_free_slip:
+            return f"{self._kernel_tier}+bc({self.bc.token})"
         return self._kernel_tier
 
     @property
@@ -370,11 +371,16 @@ class UniformGrid:
         return self.bc.token
 
     def attach_mesh(self, mesh) -> None:
-        """Give the MG hierarchy the device mesh so the FAS path runs
-        its finest-level smoothing sweeps with the explicit overlapped
-        ppermute exchange (shard_halo.overlap_jacobi_sweeps). No-op on
-        the default Krylov path: its preconditioner cycles stay on the
-        GSPMD form whose sharded==single equality is already pinned."""
+        """Record the device mesh of the sharded x-split. The fused
+        advection tier then dispatches through the halo-mode kernel
+        (shard_halo.fused_advect_heun_sharded: edge-column ppermutes
+        issued before the strip pipeline); the FAS path additionally
+        rebuilds its MG hierarchy so the finest-level smoothing sweeps
+        use the explicit overlapped ppermute exchange
+        (shard_halo.overlap_jacobi_sweeps). The default Krylov
+        preconditioner cycles stay on the GSPMD form whose
+        sharded==single equality is already pinned."""
+        self._mesh = mesh
         if self.solver_mode == "fas":
             self.mg = MultigridPreconditioner(
                 self.ny, self.nx, self.dtype,
@@ -426,10 +432,16 @@ class UniformGrid:
         (one HBM read/write per substage) instead of the
         pad -> WENO-RHS -> update dispatch chain."""
         if self._kernel_tier != "xla":
+            bf16 = self._kernel_tier == "pallas-fused-bf16"
+            bc = None if self.bc.is_free_slip else self.bc
+            if self._mesh is not None:
+                from .parallel.shard_halo import fused_advect_heun_sharded
+                return fused_advect_heun_sharded(
+                    vel, self.h, self.cfg.nu, dt, self._mesh,
+                    bc=bc, bf16=bf16)
             from .ops.pallas_kernels import fused_advect_heun
             return fused_advect_heun(
-                vel, self.h, self.cfg.nu, dt,
-                bf16=self._kernel_tier == "pallas-fused-bf16")
+                vel, self.h, self.cfg.nu, dt, bc=bc, bf16=bf16)
         ih2 = 1.0 / (self.h * self.h)
         vold = vel
         for c in (0.5, 1.0):
@@ -459,9 +471,14 @@ class UniformGrid:
         # any-Dirichlet tables (outflow face) pin the pressure level:
         # the operator is non-singular and the legacy mean removal
         # would shift the anchored solution — skip it (bc.py docs)
+        # the fused correction kernel has no halo-mode form (its
+        # stencil is purely local, but the strip DMA cannot be GSPMD-
+        # partitioned) — mesh-attached grids keep the XLA epilogue,
+        # whose sharded==single equality is pinned
+        corr_tier = "xla" if self._mesh is not None else self._kernel_tier
         vel, pres = project_correct(
             res.x, pres_old, vel, h, dt,
-            spmd_safe=self.spmd_safe, tier=self._kernel_tier,
+            spmd_safe=self.spmd_safe, tier=corr_tier,
             remove_mean=self.bc.all_neumann, grad_signs=self._psigns)
         return vel, pres, res, div_linf
 

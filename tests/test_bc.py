@@ -330,21 +330,25 @@ def test_default_table_bit_identical_sharded():
 
 
 # ---------------------------------------------------------------------------
-# loud refusals: Pallas tier, AMR forest, fleet admit
+# loud refusals: AMR forest, fleet admit (ISSUE 16 retired the Pallas
+# tier's refusal — the megakernel now honors every table kind)
 # ---------------------------------------------------------------------------
 
-def test_pallas_tier_refuses_non_free_slip_table(monkeypatch):
-    """The megakernel synthesizes MIRROR ghosts in VMEM — running it
-    under any other table would silently compute wrong walls. Refusal
-    is at grid construction, same contract as the sharded-x-split
-    refusal (test_megakernel.py)."""
+def test_pallas_tier_composes_with_bc_tables(monkeypatch):
+    """ISSUE 16: the megakernel synthesizes EVERY bc.py ghost kind in
+    VMEM (affine edge/inner-line combinations baked in at trace time),
+    so the pre-16 non-free-slip construction refusal is gone — the
+    grid latches the tier and the kernel_tier property names the
+    table's token. Equivalence bounds live in test_megakernel.py."""
     monkeypatch.setenv("CUP2D_PALLAS", "1")
     monkeypatch.delenv("CUP2D_PREC", raising=False)
     cfg = _cfg(dtype="float32")
-    with pytest.raises(ValueError, match="non-free-slip"):
-        UniformGrid(cfg, level=2, bc=cavity_table())
-    # the default table still composes with the tier request
-    UniformGrid(cfg, level=2, bc=FREE_SLIP)
+    g = UniformGrid(cfg, level=2, bc=cavity_table())
+    assert g.kernel_tier == "pallas-fused+bc(ns,ns,ns,ns(1,0))"
+    # the default table keeps the bare PR-9 tier string (and the
+    # bit-identical executable, pinned in test_megakernel.py)
+    assert UniformGrid(cfg, level=2, bc=FREE_SLIP).kernel_tier == \
+        "pallas-fused"
 
 
 def test_amr_refuses_non_free_slip_table():

@@ -108,19 +108,26 @@ def test_bench_cpu_smoke():
         assert p["iters"] >= 1 and p["ms_per_solve"] > 0, (name, p)
     assert (fc["paths"]["forest_fas"]["iters"]
             <= fc["paths"]["krylov_fft"]["iters"]), fc
-    # advection kernel-tier curve (PR 9): all three tiers present (the
-    # fused tiers run the REAL kernels in Pallas interpret mode on the
-    # CPU box, so this pins the plumbing, schema, and bytes model)
+    # advection kernel-tier curve (PR 9 + ISSUE 16): every tier
+    # present — the three PR-9 arms plus the BC'd cavity/channel arms
+    # and the 2-device sharded point (bench.py forces 2 virtual host
+    # devices before jax initializes, so the sharded arm runs even
+    # though this smoke pops XLA_FLAGS). The fused tiers run the REAL
+    # kernels in Pallas interpret mode on the CPU box, so this pins
+    # the plumbing, schema, and bytes model.
     kc = out["kernel_curve"]
     assert "error" not in kc, kc
     assert kc["interpret_mode"] is True          # CPU box
     assert set(kc["tiers"]) == {"xla", "pallas_fused",
-                                "pallas_fused_bf16"}
+                                "pallas_fused_bf16",
+                                "pallas_fused_cavity",
+                                "pallas_fused_channel",
+                                "pallas_fused_sharded"}
     for name, tr in kc["tiers"].items():
         assert tr["ms_per_substage"] > 0, (name, tr)
         assert set(tr) >= {"adv_field_reads", "adv_field_writes",
-                           "hbm_bytes", "hbm_util_pct", "mfu_pct",
-                           "storage_dtype"}, (name, tr)
+                           "hbm_bytes", "hbm_passes", "hbm_util_pct",
+                           "mfu_pct", "storage_dtype"}, (name, tr)
     # the ISSUE-9 acceptance, asserted from the bytes model: the XLA
     # chain re-reads the advected field >= 3x per substage where the
     # megakernel reads it ONCE, and the modeled HBM bytes drop
@@ -130,6 +137,19 @@ def test_bench_cpu_smoke():
             < kc["tiers"]["xla"]["hbm_bytes"])
     assert (kc["tiers"]["pallas_fused_bf16"]["hbm_bytes"]
             < kc["tiers"]["pallas_fused"]["hbm_bytes"])
+    # the ISSUE-16 acceptance: ghost synthesis is in-VMEM affine
+    # arithmetic, so every BC'd/sharded arm keeps the single-read
+    # single-write bytes model with <= 2.25 modeled f32-equiv passes
+    # and names its boundary table
+    for name in ("pallas_fused_cavity", "pallas_fused_channel",
+                 "pallas_fused_sharded"):
+        tr = kc["tiers"][name]
+        assert tr["adv_field_reads"] == 1, (name, tr)
+        assert tr["hbm_passes"] <= 2.25, (name, tr)
+        assert tr["bc_token"], (name, tr)
+    assert kc["tiers"]["pallas_fused_cavity"]["bc_token"] == \
+        "ns,ns,ns,ns(1,0)"
+    assert kc["tiers"]["pallas_fused_sharded"]["mesh"] == "x:2"
 
 
 @pytest.mark.slow   # ~5 s subprocess; the satellite's tier-1 ask is

@@ -336,6 +336,58 @@ def test_zero_recompile_steady_state_churn(tmp_path):
     log.close()
 
 
+def test_zero_recompile_bc_pallas_pool_churn(tmp_path, monkeypatch):
+    """ISSUE-16 acceptance: the zero-recompile contract extends to a
+    BC'd fused-kernel pool. All BC coefficients are trace-time
+    constants (one executable per BCTable token) and the kernel_tier
+    suffix lives on the host-side property only — so a cavity-table
+    pool on the pallas tier (f32 state, the tier's dtype contract)
+    serves a measured admit/retire churn window with jit_compiles ==
+    0, exactly like the XLA pool above."""
+    from cup2d_tpu.cases import cavity_table
+    monkeypatch.setenv("CUP2D_PALLAS", "1")
+    monkeypatch.delenv("CUP2D_PREC", raising=False)
+    sim = FleetSim(_cfg(dtype="float32", nu=4e-5), level=LVL,
+                   members=3, bc=cavity_table(1.0))
+    assert sim.kernel_tier == "pallas-fused+bc(ns,ns,ns,ns(1,0))"
+    sim.step_count = 20
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    server = FleetServer(sim, event_log=log)
+    g = sim.grid
+    n_req = 0
+
+    def submit(horizon_steps):
+        nonlocal n_req
+        st = _session_state(g, n_req % 3)
+        dt0 = float(sim._member_dt(st.vel))
+        server.submit(FleetRequest(
+            client_id=f"b{n_req:03d}", state=st,
+            t_end=(horizon_steps - 0.1) * dt0))
+        n_req += 1
+
+    # warm phase: fill, retire, refill — every executable the measured
+    # window touches compiles here
+    for _ in range(3):
+        submit(2)
+    for _ in range(5):
+        submit(2)
+        server.step()
+
+    c = HostCounters().install()
+    try:
+        retired0, admitted0 = server.retired, server.admitted
+        for _ in range(6):
+            submit(3)
+            server.step()
+    finally:
+        c.uninstall()
+    snap = c.snapshot()
+    assert server.retired > retired0       # churn happened in-window
+    assert server.admitted > admitted0
+    assert snap["jit_compiles"] == 0, snap
+    log.close()
+
+
 # ---------------------------------------------------------------------------
 # shaped membership: per-member frozen obstacles
 # ---------------------------------------------------------------------------

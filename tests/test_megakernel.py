@@ -148,34 +148,217 @@ def test_fused_correction_matches_xla():
 
 
 # ---------------------------------------------------------------------------
+# BC-aware kernel (ISSUE 16): the four ghost kinds, corner composition
+# and the parabolic clamp vs bc.py's XLA chain, all four face tables
+# ---------------------------------------------------------------------------
+
+def _xla_bc_heun(vel, h, nu, dt, bc):
+    """The BC'd XLA op chain (uniform.py's fallback path, verbatim):
+    bc.py ghost paint -> WENO RHS -> Heun substage."""
+    from cup2d_tpu.bc import pad_vector_bc
+    ih2 = 1.0 / (h * h)
+    dt_b = dt[:, None, None, None] if jnp.ndim(dt) == 1 else dt
+    vold = vel
+    v = vel
+    for c in (0.5, 1.0):
+        # dt_b: the member-batched path broadcasts dt like fleet.py's
+        # dt4 so the outflow extrapolation speed is per-member
+        lab = pad_vector_bc(v, 3, bc, h, dt_b)
+        rhs = advect_diffuse_rhs(lab, 3, h, nu, dt_b)
+        v = heun_substage(vold, c, rhs, ih2)
+    return v
+
+
+def _bc_tables():
+    from cup2d_tpu.bc import (BCTable, convective_outflow,
+                              dirichlet_inflow, no_slip)
+    from cup2d_tpu.cases import cavity_table, channel_table
+    return {
+        # four no-slip walls + moving lid: 2*uw - edge on every face,
+        # corners compose x-ghosts from the y-painted columns
+        "cavity": cavity_table(1.0),
+        # uniform Dirichlet inflow + convective outflow on the x faces
+        # (the dt-dependent extrapolation speed, clipped to [0,1])
+        "channel_uniform": channel_table(1.0),
+        # parabolic inflow: the 4s(1-s) profile along the x_lo face's
+        # PADDED rows, s clipped outside the interior band
+        "channel_parabolic": channel_table(1.0, profile="parabolic"),
+        # y-face inflow/outflow: the parabolic profile along a y face
+        # (tangent = global column index) and outflow at y_hi, with
+        # no-slip x walls reading the y-painted corners
+        "outflow_y": BCTable(no_slip(), no_slip(),
+                             dirichlet_inflow(0.0, 1.0,
+                                              profile="parabolic"),
+                             convective_outflow()),
+    }
+
+
+@pytest.mark.parametrize("name", sorted(_bc_tables()))
+def test_fused_heun_matches_xla_bc(name):
+    """Every supported ghost kind, ~1-ulp f32 equivalence vs the bc.py
+    XLA chain (the same FMA-contraction bound as the free-slip pin)."""
+    bc = _bc_tables()[name]
+    vel = _rand((2, NY, NX), 11)
+    dt = jnp.float32(0.5 * H)
+    ref = _xla_bc_heun(vel, H, NU, dt, bc)
+    got = fused_advect_heun(vel, H, NU, dt, bc=bc)
+    err = float(jnp.max(jnp.abs(got - ref)))
+    assert err <= FULL_HEUN_BOUND, (name, err)
+
+
+def test_fused_heun_bc_member_batched():
+    """BC'd kernel under the fleet's operand family: distinct
+    per-member dt rides the widened facs row (col 2 feeds the outflow
+    extrapolation speed per member)."""
+    from cup2d_tpu.cases import channel_table
+    bc = channel_table(1.0, profile="parabolic")
+    vel = _rand((3, 2, NY, NX), 12)
+    dt = jnp.asarray([0.5 * H, 0.35 * H, 0.27 * H], jnp.float32)
+    ref = _xla_bc_heun(vel, H, NU, dt, bc)
+    got = fused_advect_heun(vel, H, NU, dt, bc=bc)
+    err = float(jnp.max(jnp.abs(got - ref)))
+    # measured 2.03e-6: the outflow speed c = clip(s*en*dt/h, 0, 1) is
+    # associated differently inside the kernel ((s*en)*dtf)/h and the
+    # ~1-ulp difference in c rides (edge - inner) through the same
+    # ih2 = 4096 amplification as the base bound — a few extra ulp,
+    # not a logic error (the solo BC'd arms above stay <= 2e-6)
+    assert err <= 1e-5, err
+
+
+def test_free_slip_table_normalizes_to_base_kernel():
+    """The ISSUE-16 acceptance pin: an explicit all-free-slip table
+    normalizes to bc=None inside fused_advect_heun, so the default
+    table stays BIT-identical to the PR-9 kernel (same executable, not
+    merely close)."""
+    from cup2d_tpu.bc import BCTable
+    vel = _rand((2, NY, NX), 13)
+    dt = jnp.float32(0.5 * H)
+    base = fused_advect_heun(vel, H, NU, dt)
+    got = fused_advect_heun(vel, H, NU, dt, bc=BCTable())
+    assert float(jnp.max(jnp.abs(got - base))) == 0.0
+
+
+def test_fused_correction_carries_pressure_signs():
+    """The fused projection epilogue with a Dirichlet (outflow) face:
+    the kernel's edge-gradient coefficients take bc.py's derived
+    pressure-row signs and match the XLA chain; the default signs stay
+    bit-identical to explicit all-Neumann (1,1,1,1)."""
+    from cup2d_tpu.bc import pressure_signs
+    from cup2d_tpu.cases import channel_table
+    gs = pressure_signs(channel_table(1.0))
+    assert gs == (1.0, -1.0, 1.0, 1.0)     # x_hi outflow -> Dirichlet
+    x = _rand((NY, NX), 14)
+    pold = _rand((NY, NX), 15)
+    vel = _rand((2, NY, NX), 16)
+    dt = jnp.float32(0.5 * H)
+    vr, pr = project_correct(x, pold, vel, H, dt, tier="xla",
+                             grad_signs=gs)
+    vf, pf = project_correct(x, pold, vel, H, dt, tier="pallas-fused",
+                             grad_signs=gs)
+    assert float(jnp.max(jnp.abs(vf - vr))) <= CORRECTION_BOUND
+    assert float(jnp.max(jnp.abs(pf - pr))) <= CORRECTION_BOUND
+    v0, p0 = project_correct(x, pold, vel, H, dt, tier="pallas-fused")
+    v1, p1 = project_correct(x, pold, vel, H, dt, tier="pallas-fused",
+                             grad_signs=(1.0, 1.0, 1.0, 1.0))
+    assert float(jnp.max(jnp.abs(v1 - v0))) == 0.0
+    assert float(jnp.max(jnp.abs(p1 - p0))) == 0.0
+
+
+def test_sharded_kernel_matches_solo_kernel():
+    """The halo-mode kernel on a 2-device x-split vs the solo kernel,
+    same BC table: the per-shard ghost synthesis reads global position
+    from the info row and edge columns from the ppermuted halo operand,
+    so the split must be invisible (observed bit-identical in
+    interpret mode; asserted <= 1e-11)."""
+    from cup2d_tpu.cases import channel_table
+    from cup2d_tpu.parallel.mesh import make_mesh
+    from cup2d_tpu.parallel.shard_halo import fused_advect_heun_sharded
+    bc = channel_table(1.0, profile="parabolic")
+    vel = _rand((2, NY, NX), 17)
+    dt = jnp.float32(0.5 * H)
+    solo = fused_advect_heun(vel, H, NU, dt, bc=bc)
+    shard = fused_advect_heun_sharded(vel, H, NU, dt, make_mesh(2),
+                                      bc=bc)
+    assert float(jnp.max(jnp.abs(shard - solo))) <= 1e-11
+
+
+@pytest.mark.slow
+def test_sharded_sim_trajectory_matches_solo(monkeypatch):
+    """End-to-end ISSUE-16 acceptance: ShardedUniformSim on the fused
+    tier (2-device x-split, halo-mode kernel — the configuration the
+    pre-16 tier REFUSED at construction) tracks the solo spmd_safe sim
+    step for step to <= 1e-11 over 5 steps, and the tier string names
+    the boundary table.
+
+    slow, like PR 13's sharded FAS trajectory drill: full-sim sharded
+    trajectories pay two interpret-mode shard_map compiles (~18 s on
+    one CPU core).  The tier-1 pin for sharded == solo is the
+    kernel-level bit-identity test above, which exercises the same
+    halo-mode kernel without the sim scaffolding."""
+    from cup2d_tpu.cases import channel_table
+    from cup2d_tpu.parallel.mesh import ShardedUniformSim, make_mesh
+    monkeypatch.setenv("CUP2D_PALLAS", "1")
+    monkeypatch.delenv("CUP2D_PREC", raising=False)
+    bc = channel_table(1.0, profile="parabolic")
+    cfg = _cfg32()
+    solo = UniformSim(cfg, level=2, spmd_safe=True, bc=bc)
+    solo.state = taylor_green_state(solo.grid)
+    sh = ShardedUniformSim(cfg, make_mesh(2), level=2, bc=bc)
+    assert sh.kernel_tier == \
+        "pallas-fused+bc(in(1,0)[parabolic],out,fs,fs)"
+    sh.set_state(taylor_green_state(sh.grid))
+    dt = 0.25 * solo.grid.h
+    for _ in range(5):
+        solo.step_once(dt)
+        sh.step_once(dt)
+    dv = np.abs(np.asarray(sh.state.vel)
+                - np.asarray(solo.state.vel)).max()
+    assert dv <= 1e-11, dv
+
+
+# ---------------------------------------------------------------------------
 # tier latch + composition pins (the use_pallas composition gap, closed
 # LOUDLY — ISSUE 9 satellite)
 # ---------------------------------------------------------------------------
 
-def test_tier_refuses_sharded_x_split(monkeypatch):
-    """The kernel's wall-ghost synthesis is global-position-based: under
-    the sharded x-split each shard would mirror at an interior seam and
-    silently compute wrong physics. The grid must refuse at
-    construction — this pins the decision for every mesh caller
-    (ShardedUniformSim and spatial-placement fleets both construct
-    their grid with spmd_safe=True)."""
+def test_sharded_x_split_constructs_fused_tier(monkeypatch):
+    """ISSUE 16 retired the PR-9 construction refusal: the sharded
+    x-split now routes to the halo-mode kernel (edge-column ppermutes
+    feed a per-shard halo operand before the strip pipeline), so
+    spmd_safe construction with the tier requested SUCCEEDS and latches
+    pallas-fused — the pre-16 ValueError("sharded ...") is gone."""
     monkeypatch.setenv("CUP2D_PALLAS", "1")
     monkeypatch.delenv("CUP2D_PREC", raising=False)
-    with pytest.raises(ValueError, match="sharded"):
-        UniformGrid(_cfg32(), level=2, spmd_safe=True)
+    g = UniformGrid(_cfg32(), level=2, spmd_safe=True)
+    assert g.kernel_tier == "pallas-fused"
 
 
-def test_tier_refuses_spatial_fleet(monkeypatch):
+def test_tier_activates_for_spatial_fleet(monkeypatch):
     """The fleet's spatial placement is a mesh caller: big grids fall
-    back to the x-split, and with the fused tier requested that must be
-    the SAME loud refusal, not a silently-wrong kernel."""
+    back to the x-split, and with the fused tier requested that now
+    takes the SAME halo-mode kernel instead of the pre-16 loud
+    refusal."""
     from cup2d_tpu.fleet import FleetSim
     from cup2d_tpu.parallel.mesh import make_mesh
     monkeypatch.setenv("CUP2D_PALLAS", "1")
     monkeypatch.delenv("CUP2D_PREC", raising=False)
-    with pytest.raises(ValueError, match="sharded"):
-        FleetSim(_cfg32(), level=3, members=2, mesh=make_mesh(8),
-                 member_cells_cap=0)       # force the spatial branch
+    fleet = FleetSim(_cfg32(), level=3, members=2, mesh=make_mesh(8),
+                     member_cells_cap=0)   # force the spatial branch
+    assert fleet.placement == "spatial"
+    assert fleet.kernel_tier == "pallas-fused"
+
+
+def test_kernel_supports_refuses_unknown_kind_naming_the_token():
+    """The ONE remaining refusal (kernel_supports): a ghost kind with
+    no in-VMEM synthesis fails at construction time, loudly, naming
+    the offending face, kind and the full table token."""
+    from cup2d_tpu.bc import BCTable, FaceBC
+    from cup2d_tpu.ops.pallas_kernels import kernel_supports
+    bad = BCTable(FaceBC("periodic"), FaceBC(), FaceBC(), FaceBC())
+    with pytest.raises(ValueError) as ei:
+        kernel_supports(bad)
+    msg = str(ei.value)
+    assert "x_lo" in msg and "periodic" in msg and bad.token in msg
 
 
 def test_tier_activates_for_member_batched_fleet(monkeypatch):

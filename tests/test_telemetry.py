@@ -192,6 +192,31 @@ def test_metrics_forest_fas_mode_strings(monkeypatch):
     assert sim.poisson_mode == "bicgstab+fft"
 
 
+def test_metrics_kernel_tier_bc_suffix(monkeypatch):
+    """Schema v8 KEY set is frozen, but ISSUE 16 grew the kernel_tier
+    VALUE vocabulary: a BC'd sim on the fused tier stamps the literal
+    "pallas-fused+bc(<token>)" — captured at DISPATCH via the guard's
+    _Pending slot (PR-6 pattern: the tier the step actually RAN with,
+    immune to a drain-time latch change) and mirrored by the recorder's
+    diag-first pull — alongside the v8 bc_table token it suffixes. The
+    default free-slip table keeps the bare PR-9 string (pinned above in
+    test_metrics_schema_stable_uniform_amr_bench)."""
+    from cup2d_tpu.cases import cavity_table
+    from cup2d_tpu.uniform import UniformSim, taylor_green_state
+    monkeypatch.setenv("CUP2D_PALLAS", "1")
+    monkeypatch.delenv("CUP2D_PREC", raising=False)
+    cfg = _cfg(dtype="float32", nu=4e-5, max_poisson_iterations=60)
+    sim = UniformSim(cfg, level=2, bc=cavity_table(1.0))
+    assert sim.kernel_tier == "pallas-fused+bc(ns,ns,ns,ns(1,0))"
+    sim.state = taylor_green_state(sim.grid)
+    rec = MetricsRecorder()
+    rec.prime(sim)
+    r = rec.record(sim, sim.step_once(0.25 * sim.grid.h))
+    assert r["kernel_tier"] == "pallas-fused+bc(ns,ns,ns,ns(1,0))"
+    assert r["prec_mode"] == "f32"
+    assert r["bc_table"] == "ns,ns,ns,ns(1,0)"
+
+
 def test_metrics_jsonl_stream_and_summary(tmp_path):
     sink = EventLog(str(tmp_path / "metrics.jsonl"))
     sim = _sim()
