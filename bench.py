@@ -599,6 +599,68 @@ def run_fleet_serving(size: int, members: int = 8, n_steps: int = 60,
     }
 
 
+def run_mirror_overhead(size: int, n_iters: int = 30, n_warmup: int = 3):
+    """Host-redundant mirror tier overhead (PR 17): enqueue-side cost
+    of capturing a device snapshot WITH the neighbor mirror (one
+    shard_map ppermute + on-device per-block checksums, io.py) vs the
+    plain snapshot — the per-capture tax the ``-mirror`` flag adds to
+    a guarded elastic run. Runs on the full local device set grouped
+    into 2 "hosts" (the minimal ring); both loops are fenced with the
+    readback latency subtracted (run_size methodology). The number to
+    watch is mirror_overhead_ms staying a small fraction of a step —
+    the mirror is enqueue-only and overlaps the next dispatch, so the
+    exposed cost in a real run is lower still."""
+    from cup2d_tpu.config import SimConfig
+    from cup2d_tpu.io import (mirror_nbytes, mirror_snapshot,
+                              snapshot_nbytes, snapshot_state_device)
+    from cup2d_tpu.parallel.mesh import ShardedUniformSim, make_mesh
+    from cup2d_tpu.uniform import taylor_green_state
+
+    level = int(np.log2(size // 8))
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=1, level_start=0,
+                    extent=1.0, nu=4e-5, cfl=0.5, dtype="float32")
+    mesh = make_mesh()
+    n_hosts = 2
+    sim = ShardedUniformSim(cfg, mesh, level=level)
+    sim.set_state(taylor_green_state(sim.grid))
+    for _ in range(n_warmup):        # compile ppermute + checksum jits
+        snap = snapshot_state_device(sim)
+        m = mirror_snapshot(snap, mesh, n_hosts)
+        if m is None:
+            raise RuntimeError("mirror_snapshot refused the uniform "
+                               "payload — bench rig mismatch")
+    _fence(m.payload["vel"])
+    lat = _latency_floor(sim.state.pres)
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        snap = snapshot_state_device(sim)
+    _fence(snap.payload["vel"])
+    plain = max(time.perf_counter() - t0 - lat, 1e-9)
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        snap = snapshot_state_device(sim)
+        m = mirror_snapshot(snap, mesh, n_hosts)
+    _fence(m.payload["vel"])
+    mirrored = max(time.perf_counter() - t0 - lat, 1e-9)
+    snap = snap._replace(mirror=m)
+    return {
+        "grid": f"{size}x{size}",
+        "devices": mesh.devices.size,
+        "hosts": n_hosts,
+        "iters": n_iters,
+        "snap_ms": round(plain / n_iters * 1e3, 3),
+        "snap_mirror_ms": round(mirrored / n_iters * 1e3, 3),
+        "mirror_overhead_ms": round(
+            max(mirrored - plain, 0.0) / n_iters * 1e3, 3),
+        "snapshot_bytes": int(snapshot_nbytes(snap)),
+        "mirror_bytes": int(mirror_nbytes(snap)),
+        "note": ("per-capture cost of the neighbor mirror (ppermute + "
+                 "device checksums) over the plain device snapshot; "
+                 "enqueue-side — in a guarded run the collective "
+                 "overlaps the next dispatch"),
+    }
+
+
 def run_poisson_curve(size: int, tol_rel: float = 1e-3,
                       n_rep: int = 3):
     """Poisson solver micro-curve (PR 6): iterations-to-tolerance and
@@ -1015,6 +1077,17 @@ def main():
                 n_steps=int(os.environ.get("BENCH_SERVE_STEPS", "60")))
         except Exception as e:           # noqa: BLE001 - bench must print
             serving = {"error": f"{type(e).__name__}: {e}"}
+    # mirror-overhead point (BENCH_MIRROR=0 skips; BENCH_MIRROR_SIZE
+    # picks the grid — 256^2 default keeps the CPU CI point cheap
+    # while still big enough that the permute cost is visible)
+    mirror = None
+    if os.environ.get("BENCH_MIRROR", "1") != "0":
+        try:
+            mirror = run_mirror_overhead(
+                int(os.environ.get("BENCH_MIRROR_SIZE", "256")),
+                n_iters=int(os.environ.get("BENCH_MIRROR_ITERS", "30")))
+        except Exception as e:           # noqa: BLE001 - bench must print
+            mirror = {"error": f"{type(e).__name__}: {e}"}
     # Poisson solve-path micro-curve (BENCH_POISSON=0 skips;
     # BENCH_POISSON_SIZE picks the grid — 1024^2 default keeps the
     # block-Jacobi baseline arm's iteration train bounded)
@@ -1103,6 +1176,8 @@ def main():
         out["fleet"] = fleet
     if serving:
         out["fleet_serving"] = serving
+    if mirror:
+        out["mirror"] = mirror
     if poisson:
         out["poisson_curve"] = poisson
     if kernel:

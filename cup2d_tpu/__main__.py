@@ -51,13 +51,21 @@ after ``-heartbeatMissK K`` missed beats), survivors agreeing on the
 same shrunk device set from the same evidence. On a SIMULATED topology
 (``-simHosts H`` groups the virtual devices of a single-process run
 into H hosts; losses injected by CUP2D_FAULTS host_exit@N /
-host_hang@N) recovery is fully in place: re-mesh the survivors, resume
-from the device snapshot ring (disk where the ring does not cover),
-continue — no relaunch. On a REAL pod the CLI today gives bounded
-detection + an orderly abort (the old behavior was an indefinite
-hang); the in-place runtime re-init (launch.reinit_distributed) is
-library-level, pending a working multi-process runtime to validate
-against (ROADMAP).
+host_hang@N, with shard_loss@N zeroing the lost host's shard bytes for
+an honest real-loss drill) recovery is fully in place: re-mesh the
+survivors, resume from the device snapshot ring, continue — no
+relaunch. With >= 2 hosts the elastic guard also arms the
+HOST-REDUNDANT MIRROR TIER (PR 17, default on; ``-noMirror`` off,
+``-mirror`` explicit, ``-mirrorEvery N`` thins the cadence): each
+snapshot additionally ships every host's shard block to its ring
+neighbor via one device-side ppermute, checksummed, so a loss that
+DESTROYS the owner's shards still resumes from HBM down the
+ring → mirror → disk → abort ladder (a corrupt/stale mirror is
+rejected — ``mirror_reject`` event — never installed). On a REAL pod
+the CLI today gives bounded detection + an orderly abort (the old
+behavior was an indefinite hang); the in-place runtime re-init
+(launch.reinit_distributed) is library-level, pending a working
+multi-process runtime to validate against (ROADMAP).
 
 CASE CATALOG (cases.py + bc.py, ISSUE 12): ``-case cavity|channel|
 cylinder`` runs a named validation workload instead of parsing a
@@ -329,6 +337,15 @@ def main(argv=None) -> int:
             timeout=(p("heartbeatTimeout").asDouble()
                      if p.has("heartbeatTimeout") else 10.0),
             faults=plan, event_log=log)
+    # host-redundant mirror tier (PR 17): defaults ON when the elastic
+    # machinery is armed over >= 2 (simulated or real) hosts — that is
+    # exactly the regime where a host loss is survivable in HBM.
+    # -mirror forces it on (still needs a topology), -noMirror off;
+    # -mirrorEvery N thins the cadence.
+    mirror_hosts = None
+    if topo is not None and not p.has("noMirror"):
+        if topo.n_hosts >= 2 and (p.has("mirror") or p.has("elastic")):
+            mirror_hosts = topo.n_hosts
     guard_cls = FleetStepGuard if fleet_n else StepGuard
     guard = guard_cls(
         sim,
@@ -341,6 +358,9 @@ def main(argv=None) -> int:
         watchdog=None if p.has("noWatchdog") else PhysicsWatchdog(),
         snap_every=p("snapEvery").asInt() if p.has("snapEvery") else 1,
         lag=not p.has("noLag"),
+        mirror_hosts=mirror_hosts,
+        mirror_every=(p("mirrorEvery").asInt()
+                      if p.has("mirrorEvery") else 1),
     )
 
     # -serve N: continuous-batching serving — N staggered-horizon

@@ -106,9 +106,13 @@ HOST_SYNC_SITES = {
     # transfer (HostCounters.state_gathers meters them at runtime).
     # _to_host_global is the owning-copy pull under them all — a
     # collective on pods, and deliberately np.array (not a view): the
-    # snapshot ring holds its results across donated-buffer steps
+    # snapshot ring holds its results across donated-buffer steps.
+    # verify_mirror is the mirror tier's checksum pull (PR 17): ONE
+    # batched device_get of the uint32 block sums, on the cold
+    # elastic-recovery path only — capture-side mirroring is pure
+    # device collectives and never syncs
     "io.py": {"_gather_state", "restore_snapshot_device",
-              "_to_host_global"},
+              "_to_host_global", "verify_mirror"},
     # the counting wrapper itself (wraps jax.device_get to meter pulls)
     # and the recorder's library-path fallback (one pull, documented)
     "profiling.py": {"_install_hooks", "MetricsRecorder.record_step"},

@@ -47,6 +47,28 @@ Spec syntax — comma-separated directives, ``name[@STEP][*COUNT]``::
                           path). Host-loss tokens are CONSUMED by the
                           TopologyGuard (resilience.py); without an
                           elastic guard they never fire.
+    shard_loss@N          real-loss semantics for a SIMULATED host loss
+                          at step N: the dead host's shard slices —
+                          live state, every snapshot-ring payload, and
+                          the mirror slices it physically held — are
+                          ZEROED before recovery runs
+                          (io.destroy_shards), exactly what a real
+                          host loss takes with it. Pairs with
+                          host_exit@N/host_hang@N; this is what makes
+                          the CPU mirrored-ring drill honest (a
+                          resumed trajectory provably came from the
+                          neighbor's mirror, not the "lost" originals).
+                          Consumed by the TopologyGuard at the same
+                          boundary as the host-loss token.
+    mirror_corrupt@N      flip one element's bit pattern in every host
+                          block of every held mirror at step N's
+                          dispatch (io.corrupt_mirror) WITHOUT updating
+                          the stored checksums — drives the
+                          checksum-reject path: the mirrored-ring rung
+                          must detect the corruption (mirror_reject
+                          event) and degrade to the disk rung rather
+                          than install torn bytes. Consumed by the
+                          StepGuard.
 
 ``*K`` repeats the fault for K consecutive attempts of that step, which
 is how a test climbs the ladder: ``*1`` recovers at the rewind-retry
@@ -85,6 +107,8 @@ class FaultPlan:
         self.sigterm_steps: set[int] = set()
         self.crash_points: dict[str, int] = {}  # name -> count
         self.host_loss: dict[int, list] = {}    # step -> ["exit"|"hang"]
+        self.shard_loss: dict[int, int] = {}    # step -> count
+        self.mirror_corrupt: dict[int, int] = {}  # step -> count
         # replay suspension (StepGuard.snapshot-cadence recovery): a
         # restore-and-replay re-runs ALREADY-VERDICTED-GOOD steps, so
         # an armed *K fault whose step lands mid-replay must not fire
@@ -127,11 +151,20 @@ class FaultPlan:
                     raise ValueError(f"{name} needs @STEP")
                 self.host_loss.setdefault(step, []).append(
                     name.split("_", 1)[1])
+            elif name == "shard_loss":
+                if step is None:
+                    raise ValueError("shard_loss needs @STEP")
+                self.shard_loss[step] = count
+            elif name == "mirror_corrupt":
+                if step is None:
+                    raise ValueError("mirror_corrupt needs @STEP")
+                self.mirror_corrupt[step] = count
             else:
                 raise ValueError(
                     f"unknown fault directive {name!r} "
                     "(expected nan_vel|inf_vel|scale_vel|poisson_giveup|"
-                    "sigterm|crash_in_save|host_exit|host_hang)")
+                    "sigterm|crash_in_save|host_exit|host_hang|"
+                    "shard_loss|mirror_corrupt)")
 
     @classmethod
     def from_env(cls) -> "FaultPlan":
@@ -141,7 +174,8 @@ class FaultPlan:
     def __bool__(self) -> bool:
         return bool(self.vel_poison or self.vel_scale or self.giveup
                     or self.sigterm_steps or self.crash_points
-                    or self.host_loss)
+                    or self.host_loss or self.shard_loss
+                    or self.mirror_corrupt)
 
     # -- replay suspension --------------------------------------------
     @contextlib.contextmanager
@@ -209,6 +243,31 @@ class FaultPlan:
         if self._suspended:
             return []
         return self.host_loss.pop(step, [])
+
+    def shard_loss_at(self, step: int) -> bool:
+        """Consume one shard-destruction count for ``step`` if armed
+        (the TopologyGuard's companion lookup to host_loss_at: the loss
+        declared at this boundary takes its shards with it). Suspended
+        during guard replay like every other injector."""
+        if self._suspended:
+            return False
+        c = self.shard_loss.get(step, 0)
+        if c <= 0:
+            return False
+        self.shard_loss[step] = c - 1
+        return True
+
+    def mirror_corrupt_at(self, step: int) -> bool:
+        """Consume one mirror-corruption count for ``step`` if armed
+        (the StepGuard's per-dispatch lookup). Suspended during guard
+        replay: a replay must not re-corrupt a repaired ring."""
+        if self._suspended:
+            return False
+        c = self.mirror_corrupt.get(step, 0)
+        if c <= 0:
+            return False
+        self.mirror_corrupt[step] = c - 1
+        return True
 
     def fire_crash_point(self, name: str) -> None:
         c = self.crash_points.get(name, 0)

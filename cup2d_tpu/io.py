@@ -592,6 +592,7 @@ class DeviceSnapshot(NamedTuple):
     meta: dict           # host scalars (+ forest keys for topo restore)
     dev: dict            # dt-cache entries still on device
     shapes_pkl: object   # bytes | None
+    mirror: object = None  # MirroredSnapshot | None (host-redundant tier)
 
 
 def _split_cache(meta: dict, dev: dict, name: str, val) -> None:
@@ -752,31 +753,67 @@ def restore_snapshot_device(sim, snap: DeviceSnapshot) -> None:
 # elastic topology resume (PR 7): snapshot coverage + re-sharding restore
 # ---------------------------------------------------------------------------
 
-def snapshot_covers(snap, lost_processes=()) -> bool:
+def snapshot_covers(snap, lost_processes=(), *, lost_hosts=(),
+                    shards_destroyed=False, mirror=True) -> bool:
     """True iff a :class:`DeviceSnapshot` can seed an elastic resume
-    after a topology loss: every payload shard must still be readable.
+    after a topology loss: every payload shard must still be readable
+    from its OWNER, or (mirror-aware coverage, the host-redundant tier)
+    from the ring neighbor that holds its mirror.
 
     The snapshot payload is per-shard-local device copies (the module
-    note above), so the rule is addressability: a shard held only by a
-    LOST process died with it, and on a multi-host pod each process
-    only ever addresses its own shards — a real host loss therefore
-    fails this check for any cross-host-sharded state, and the elastic
-    path falls back to the disk checkpoint (whose save was a collective
-    gather to shared storage). SIMULATED topologies (a single process
-    whose virtual devices are grouped into fake hosts,
-    resilience.TopologyGuard(sim_hosts=...)) keep every shard
-    addressable — the in-HBM resume path the tier-1 drill exercises
-    end-to-end."""
+    note above), so the owner rule is addressability: a shard held only
+    by a LOST process died with it, and on a multi-host pod each
+    process only ever addresses its own shards — a real host loss
+    therefore fails the owner check for any cross-host-sharded state.
+    SIMULATED topologies (a single process whose virtual devices are
+    grouped into fake hosts, resilience.TopologyGuard(sim_hosts=...))
+    keep every shard addressable; ``shards_destroyed=True`` is the
+    simulated real-loss semantics (the ``shard_loss@N`` injector zeroed
+    the lost hosts' slices), voiding owner coverage the way a real loss
+    would.
+
+    Mirror coverage (``mirror=True``, the default): a shard whose owner
+    died is still covered when the snapshot carries a
+    :class:`MirroredSnapshot` and the lost host's ring neighbor — the
+    holder of its mirror block — is itself alive. ``lost_hosts`` names
+    the dead hosts by ring index (simulated hosts or real processes;
+    both ride the same contiguous-block ring). Pass ``mirror=False`` to
+    ask about the owner-only (plain ring) rung."""
     import jax
 
     lost = set(lost_processes)
-    for v in snap.payload.values():
-        if isinstance(v, jax.Array):
-            if not v.is_fully_addressable:
-                return False
-            if lost and any(d.process_index in lost
-                            for d in v.sharding.device_set):
-                return False
+    dead = set(lost_hosts) | lost
+    owner_ok = not (shards_destroyed and dead)
+    if owner_ok:
+        for v in snap.payload.values():
+            if isinstance(v, jax.Array):
+                if not v.is_fully_addressable:
+                    owner_ok = False
+                    break
+                if lost and any(d.process_index in lost
+                                for d in v.sharding.device_set):
+                    owner_ok = False
+                    break
+    if owner_ok:
+        return True
+    m = getattr(snap, "mirror", None)
+    if not mirror or m is None or not dead:
+        return False
+    # every dead host's mirror holder (ring neighbor) must be alive —
+    # two adjacent losses take a block AND its only mirror
+    for h in dead:
+        if (h + 1) % m.n_hosts in dead:
+            return False
+    # and the holders' mirror slices must be readable from here. On the
+    # simulated drill every shard stays addressable; after a REAL
+    # process loss cross-host arrays stop being fully addressable until
+    # the survivor runtime re-inits (launch.reinit_distributed — the
+    # ROADMAP real-pod remainder), so real-mode mirror coverage is
+    # honest about that prerequisite rather than promising a read that
+    # would hang
+    for v in m.payload.values():
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            return False
     return True
 
 
@@ -806,3 +843,294 @@ def restore_snapshot_resharded(sim, snap: "DeviceSnapshot") -> None:
         if mesh is not None:
             sim._next_dt = jax.device_put(
                 nd, NamedSharding(mesh, PartitionSpec()))
+
+
+# ---------------------------------------------------------------------------
+# host-redundant mirrored snapshot tier (PR 17): in-HBM recovery from
+# REAL host loss. A per-shard-local ring entry dies with its host; the
+# mirror tier additionally ships every host's contiguous shard block to
+# its ring neighbor at capture time (the host-granular shard_map
+# ppermute of parallel.mesh.host_ring_shift, fused with the checksums
+# into ONE launch — _mirror_capture_fn — and enqueued off the critical
+# path before the next dispatch donates the source buffers),
+# checksummed per host block ON DEVICE so the capture stays
+# transfer-free and a torn/corrupt mirror is detected at restore time
+# rather than installed. Lose host
+# h: its block still lives (physically) on host h+1, realigned at
+# restore by the global-roll identity the exchange satisfies.
+# ---------------------------------------------------------------------------
+
+
+class MirroredSnapshot(NamedTuple):
+    """The neighbor-held redundancy of one :class:`DeviceSnapshot`.
+
+    ``payload[k]`` has the SAME global shape and sharding as the
+    snapshot field it mirrors, but globally rolled by one host-block
+    width (+Nx/H columns): the slice physically resident on host h's
+    devices is host h-1's data. ``sums[k]`` is the capture-time [H]
+    uint32 bitwise checksum vector, one entry per physical host block,
+    kept on device until a restore actually needs the comparison."""
+
+    payload: dict        # field name -> ring-shifted device array
+    sums: dict           # field name -> [H] uint32 device checksums
+    n_hosts: int         # ring size at capture time
+
+
+def _block_sums(v, h: int):
+    """Trace body of the per-host-block checksum: reshape the x axis
+    into (H, W) blocks, bitcast to uint32, wrap-sum every axis but the
+    block one (mod 2**32) — any byte flip in a block moves its entry."""
+    import jax
+    import jax.numpy as jnp
+
+    w = v.shape[-1] // h
+    vr = v.reshape(v.shape[:-1] + (h, w))
+    bits = jax.lax.bitcast_convert_type(vr, jnp.uint32)
+    axes = tuple(i for i in range(bits.ndim) if i != vr.ndim - 2)
+    return jnp.sum(bits, axis=axes, dtype=jnp.uint32)
+
+
+_mirror_sums_jit = None
+
+
+def _mirror_block_sums(x, n_hosts: int):
+    """[H] uint32 bitwise checksum of ONE x-split field (see
+    :func:`_block_sums`) — a device-side reduction, zero host
+    transfers. Single-field unit/test entry point; multi-field callers
+    must use :func:`_mirror_block_sums_tree` so all fields share one
+    launch (collective-ordering contract below)."""
+    global _mirror_sums_jit
+    import jax
+
+    if _mirror_sums_jit is None:
+        _mirror_sums_jit = jax.jit(_block_sums, static_argnums=(1,))
+    return _mirror_sums_jit(x, n_hosts)
+
+
+_mirror_sums_tree_jit = None
+
+
+def _mirror_block_sums_tree(payload: dict, n_hosts: int) -> dict:
+    """Block checksums of EVERY field in ONE jitted launch. The sum of
+    a sharded block spans that host's device pair, so each field's
+    checksum compiles to per-host-group collectives; fusing the fields
+    into one executable keeps those collectives in one consistent
+    per-device schedule (the CPU client runs independent launches out
+    of order — see :func:`mirror_snapshot`)."""
+    global _mirror_sums_tree_jit
+    import jax
+
+    if _mirror_sums_tree_jit is None:
+        _mirror_sums_tree_jit = jax.jit(
+            lambda pl, h: {k: _block_sums(v, h) for k, v in pl.items()},
+            static_argnums=(1,))
+    return _mirror_sums_tree_jit(payload, n_hosts)
+
+
+# fused capture executables: one per (mesh, host count, field-rank
+# signature) — the capture runs per snapshot, so the jit must be
+# reused, never rebuilt (same rule as mesh._RING_SHIFT_CACHE)
+_MIRROR_CAPTURE_CACHE: dict = {}
+
+
+def _mirror_capture_fn(mesh, n_hosts: int, sig: tuple):
+    """Build (or fetch) the ONE-launch capture executable: every
+    field's ring shift (a single shard_map issuing the host-granular
+    ppermute per field — the same (i, i+D/H) perm as
+    parallel.mesh.host_ring_shift) and every field's block checksums,
+    inside one jitted program. One launch is a CORRECTNESS requirement,
+    not a dispatch micro-optimisation: the shift's CollectivePermute
+    and the checksums' per-host-group reductions are collectives, and
+    the PJRT CPU client may execute independent launches out of order
+    per device — per-field launches can interleave into a cross-launch
+    rendezvous deadlock (observed in the CLI drill). Inside one
+    program every device runs the same collective schedule."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from .parallel.mesh import _shard_map
+
+    key = (mesh, int(n_hosts), sig)
+    fn = _MIRROR_CAPTURE_CACHE.get(key)
+    if fn is None:
+        n_dev = mesh.devices.size
+        dph = n_dev // n_hosts
+        perm = [(i, (i + dph) % n_dev) for i in range(n_dev)]
+        specs = {k: P(*([None] * (nd - 1) + ["x"])) for k, nd in sig}
+
+        def _shift_all(pl):
+            return {k: jax.lax.ppermute(v, "x", perm=perm)
+                    for k, v in pl.items()}
+
+        shift = _shard_map(_shift_all, mesh=mesh,
+                           in_specs=(specs,), out_specs=specs)
+
+        def impl(pl):
+            mirrored = shift(pl)
+            sums = {k: _block_sums(v, n_hosts)
+                    for k, v in mirrored.items()}
+            return mirrored, sums
+
+        fn = jax.jit(impl)
+        _MIRROR_CAPTURE_CACHE[key] = fn
+    return fn
+
+
+def mirror_snapshot(snap: DeviceSnapshot, mesh, n_hosts: int):
+    """Capture the host-redundant mirror of ``snap``: ring-shift every
+    payload field one host block to the right + per-block checksums,
+    all in ONE launch (:func:`_mirror_capture_fn`). Device-only (no
+    pulls, no host staging); returns None for payloads the tier does
+    not cover (the forest family's padded block-leading layout keeps
+    its disk rung for real losses — documented in the README
+    recoverability matrix)."""
+    import jax
+
+    if snap.meta.get("kind") != "uniform":
+        return None
+    for v in snap.payload.values():
+        if not (isinstance(v, jax.Array) and v.ndim >= 2
+                and v.shape[-1] % n_hosts == 0):
+            return None
+    sig = tuple(sorted((k, v.ndim) for k, v in snap.payload.items()))
+    fn = _mirror_capture_fn(mesh, int(n_hosts), sig)
+    payload, sums = fn(dict(snap.payload))
+    if jax.default_backend() == "cpu":
+        # capture fence, CPU ONLY: the next step's dispatch reads the
+        # same state arrays this capture read, so its halo collectives
+        # are launch-order independent of ours — and the CPU client
+        # honors no cross-launch device order, so the two can deadlock
+        # at rendezvous. Settling the (tiny, [H] uint32) checksum
+        # outputs settles the whole capture program before anything
+        # else is enqueued. TPU streams execute launches in enqueue
+        # order per device, so the fence (and the hazard) don't exist
+        # there and the capture stays off the critical path.
+        for s in sums.values():
+            s.block_until_ready()
+    return MirroredSnapshot(payload=payload, sums=sums,
+                            n_hosts=int(n_hosts))
+
+
+def mirror_nbytes(snap) -> int:
+    """HBM footprint of a snapshot's mirror payload (0 when absent) —
+    host metadata on the arrays, no sync."""
+    m = getattr(snap, "mirror", None)
+    if m is None:
+        return 0
+    return int(sum(getattr(v, "nbytes", 0) for v in m.payload.values()))
+
+
+def _lost_col_mask(nx: int, lost_hosts, n_hosts: int) -> np.ndarray:
+    """Boolean [nx] mask of the x-columns owned by the lost hosts
+    (contiguous block h*Nx/H .. (h+1)*Nx/H, the TopologyGuard's host
+    grouping)."""
+    w = nx // n_hosts
+    mask = np.zeros(nx, bool)
+    for h in lost_hosts:
+        mask[h * w:(h + 1) * w] = True
+    return mask
+
+
+def verify_mirror(snap: DeviceSnapshot, lost_hosts) -> list:
+    """Checksum the mirror blocks an elastic restore would install (the
+    lost hosts' holder blocks) against their capture-time sums. Returns
+    the rejects — [] means the mirror is installable. ONE batched
+    device_get of the small checksum vectors; runs only on the cold
+    restore path, never per step."""
+    import jax
+
+    m = snap.mirror
+    holders = sorted({(h + 1) % m.n_hosts for h in lost_hosts})
+    current = _mirror_block_sums_tree(dict(m.payload), m.n_hosts)
+    expect, actual = jax.device_get((m.sums, current))
+    bad = []
+    for k in sorted(m.payload):
+        for h in holders:
+            if int(expect[k][h]) != int(actual[k][h]):
+                bad.append({"field": k, "block": int(h),
+                            "expected": int(expect[k][h]),
+                            "actual": int(actual[k][h])})
+    return bad
+
+
+def corrupt_mirror(snap: DeviceSnapshot) -> bool:
+    """Fault injector (faults.py ``mirror_corrupt@N``): flip one
+    element's bit pattern in EVERY host block of every mirror field —
+    sign-flip of the block's first element, which moves that block's
+    uint32 word sum whatever the value (the ±0.0 patterns differ too) —
+    WITHOUT touching the stored checksums, so the next verify_mirror
+    rejects whichever holder block a restore asks about. Returns False
+    when the snapshot carries no mirror."""
+    m = snap.mirror
+    if m is None:
+        return False
+    payload = {}
+    for k, v in m.payload.items():
+        w = v.shape[-1] // m.n_hosts
+        idx = (0,) * (v.ndim - 1) + (slice(None, None, w),)
+        payload[k] = v.at[idx].multiply(-1.0)
+    snap.mirror.payload.clear()
+    snap.mirror.payload.update(payload)
+    return True
+
+
+def destroy_shards(sim, snaps, lost_hosts, n_hosts: int) -> list:
+    """The simulated real-loss semantics (faults.py ``shard_loss@N``):
+    ZERO the lost hosts' x-column blocks in the live state and in every
+    snapshot — payloads AND the mirror slices physically resident on
+    the dead hosts — exactly what a real host loss takes to the grave.
+    This is what makes the CPU drill honest: after it runs, a resumed
+    trajectory can only have come from surviving mirror blocks (or
+    disk), never from the "lost" originals. Returns the replaced
+    snapshot list (DeviceSnapshot is immutable); mutates ``sim.state``
+    in place."""
+    import jax.numpy as jnp
+
+    def wipe(v):
+        mask = jnp.asarray(_lost_col_mask(v.shape[-1], lost_hosts,
+                                          n_hosts))
+        return jnp.where(mask, 0, v)
+
+    if hasattr(sim, "state") and not hasattr(sim, "forest"):
+        sim.state = type(sim.state)(
+            **{k: wipe(v) for k, v in sim.state._asdict().items()})
+    out = []
+    for s in snaps:
+        payload = {k: wipe(v) for k, v in s.payload.items()}
+        m = s.mirror
+        if m is not None:
+            m = m._replace(payload={k: wipe(v)
+                                    for k, v in m.payload.items()})
+        out.append(s._replace(payload=payload, mirror=m))
+    return out
+
+
+def restore_snapshot_mirrored(sim, snap: DeviceSnapshot,
+                              lost_hosts) -> None:
+    """The mirrored-ring rung: reconstruct the lost hosts' shard blocks
+    from their ring neighbors' mirror slices, then install through the
+    standard re-sharding restore. The mirror is globally
+    roll(x, +Nx/H), so roll(mirror, -Nx/H) realigns it; the lost
+    columns are taken from the realigned mirror, everything else from
+    the (still-owned) primary payload. Only valid where
+    :func:`snapshot_covers` said so with ``mirror=True`` and
+    :func:`verify_mirror` returned no rejects."""
+    import jax
+    import jax.numpy as jnp
+
+    m = snap.mirror
+    # the eager jnp.roll on a still-sharded mirror compiles to
+    # collective permutes; on the CPU client independent per-field
+    # launches can reorder into a rendezvous deadlock (the capture-side
+    # story, mirror_snapshot), so serialize them there. Cold path —
+    # runs once per recovery.
+    fence = jax.default_backend() == "cpu"
+    payload = {}
+    for k, v in snap.payload.items():
+        nx = v.shape[-1]
+        mask = jnp.asarray(_lost_col_mask(nx, lost_hosts, m.n_hosts))
+        realigned = jnp.roll(m.payload[k], -(nx // m.n_hosts), axis=-1)
+        payload[k] = jnp.where(mask, realigned, v)
+        if fence:
+            payload[k].block_until_ready()
+    restore_snapshot_resharded(
+        sim, snap._replace(payload=payload, mirror=None))

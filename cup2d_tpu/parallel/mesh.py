@@ -150,3 +150,56 @@ class ShardedUniformSim(UniformSim):
 
     def set_state(self, state: FlowState):
         self.state = shard_state(state, self.mesh)
+
+
+# ---------------------------------------------------------------------------
+# host-ring mirror exchange (the host-redundant snapshot tier, io.py):
+# one collective that sends every host's contiguous shard block to its
+# ring neighbor. Same machinery class as the shard_halo surface
+# exchange — a shard_map body issuing a single lax.ppermute over sparse
+# (src, dst) pairs — but host-granular: with D devices grouped into H
+# contiguous simulated/real hosts (D/H devices each), device i sends
+# its whole shard to device (i + D/H) % D, so host h's x-columns land
+# physically on host h+1. Globally the result is exactly
+# roll(x, +Nx/H, axis=-1); the restore side (io.py) relies on that
+# identity to realign the mirror.
+# ---------------------------------------------------------------------------
+
+try:                                   # stable API (jax >= 0.5)
+    from jax import shard_map as _shard_map
+except ImportError:                    # this image's 0.4.x line
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# executable cache: one compiled shift per (mesh, host count, rank) —
+# the capture path runs per snapshot, so the jit must be reused, never
+# rebuilt (a fresh lambda per call would recompile every capture)
+_RING_SHIFT_CACHE: dict = {}
+
+
+def host_ring_shift(x, mesh: Mesh, n_hosts: int):
+    """Ring-neighbor mirror of an x-split array: each host's contiguous
+    column block moves one host to the right (wrapping), as a single
+    per-device ``lax.ppermute``. The output is a FRESH buffer with the
+    input's sharding — donation-safe for the snapshot ring by the same
+    stream-order argument as :func:`cup2d_tpu.io.device_copy` (the
+    permute is enqueued before the next step's jit donates its
+    sources). Pure device collective: zero host transfers."""
+    n_dev = mesh.devices.size
+    if n_hosts < 2 or n_dev % n_hosts != 0:
+        raise ValueError(
+            f"host ring needs >=2 hosts dividing the mesh "
+            f"(n_hosts={n_hosts}, devices={n_dev})")
+    key = (mesh, int(n_hosts), x.ndim)
+    fn = _RING_SHIFT_CACHE.get(key)
+    if fn is None:
+        dph = n_dev // n_hosts
+        perm = [(i, (i + dph) % n_dev) for i in range(n_dev)]
+        spec = P(*([None] * (x.ndim - 1) + ["x"]))
+
+        def _shift(s):
+            return jax.lax.ppermute(s, "x", perm=perm)
+
+        fn = jax.jit(_shard_map(_shift, mesh=mesh,
+                                in_specs=(spec,), out_specs=spec))
+        _RING_SHIFT_CACHE[key] = fn
+    return fn(x)

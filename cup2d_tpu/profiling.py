@@ -345,7 +345,7 @@ class HostCounters:
 # always present; fields that do not apply to a path (AMR shape on a
 # uniform run, comm volume on a single device, counters when disabled)
 # are null — consumers key on names, never on presence.
-METRICS_SCHEMA_VERSION = 8
+METRICS_SCHEMA_VERSION = 9
 METRICS_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     # solver health + timestep state (the step's existing diag pull).
@@ -406,6 +406,14 @@ METRICS_KEYS = (
     # on ordinary steps) — a topology loss and its recovery are
     # attributable from metrics.jsonl alone
     "topology_epoch", "remesh_count", "remesh_ms",
+    # host-redundant mirror tier (schema v9, PR 17): HBM footprint of
+    # the held neighbor-mirror payloads (absolute bytes; null with the
+    # tier off), the enqueue-side mirror cost landed since the
+    # previous record (delta ms, null when none), and the rung the
+    # LAST recovery restored from ("ring"|"mirror"|"disk", null until
+    # a recovery happens) — a real-loss resume is attributable from
+    # metrics.jsonl alone
+    "mirror_bytes", "mirror_ms", "restore_source",
     # fleet batching (schema v3, fleet.py): member count of the fused
     # dispatch, its throughput in member-steps/s (B / wall of the one
     # dispatch — THE dispatch-amortization metric), and per-member
@@ -491,6 +499,7 @@ class MetricsRecorder:
         self._last_regrid = (0, 0)
         self._last_replayed = 0
         self._last_remesh_ms = 0.0
+        self._last_mirror_ms = 0.0
         self._lvl_cache = (None, None, None)   # (version, hist, n)
 
     def prime(self, sim) -> None:
@@ -658,18 +667,26 @@ class MetricsRecorder:
         (absolute — host metadata on the arrays, no sync), the
         replayed-step delta of the snapshot-cadence recovery path, and
         the elastic-topology group (schema v5): epoch / cumulative
-        re-mesh count / per-record re-mesh wall cost, all host state on
-        the guard."""
+        re-mesh count / per-record re-mesh wall cost, the mirror-tier
+        group (schema v9): held mirror bytes / per-record mirror
+        enqueue cost / last restore rung, all host state on the
+        guard."""
         if self.guard is None:
             return {"snap_ring_bytes": None, "replayed_steps": None,
                     "topology_epoch": None, "remesh_count": None,
-                    "remesh_ms": None}
+                    "remesh_ms": None, "mirror_bytes": None,
+                    "mirror_ms": None, "restore_source": None}
         cur = int(getattr(self.guard, "replayed_steps", 0))
         delta = cur - self._last_replayed
         self._last_replayed = cur
         ms_total = float(getattr(self.guard, "remesh_ms_total", 0.0))
         ms_delta = ms_total - self._last_remesh_ms
         self._last_remesh_ms = ms_total
+        mirroring = getattr(self.guard, "mirror_hosts", None) is not None
+        mir_total = float(getattr(self.guard, "mirror_ms_total", 0.0))
+        mir_delta = mir_total - self._last_mirror_ms
+        self._last_mirror_ms = mir_total
+        src = getattr(self.guard, "restore_source", None)
         return {"snap_ring_bytes": int(self.guard.ring_nbytes()),
                 "replayed_steps": delta,
                 "topology_epoch": int(
@@ -677,7 +694,13 @@ class MetricsRecorder:
                 "remesh_count": int(
                     getattr(self.guard, "remesh_count", 0)),
                 "remesh_ms": (round(ms_delta, 3)
-                              if ms_delta > 0 else None)}
+                              if ms_delta > 0 else None),
+                "mirror_bytes": (int(self.guard.mirror_nbytes())
+                                 if mirroring else None),
+                "mirror_ms": (round(mir_delta, 3)
+                              if mir_delta > 0 else None),
+                "restore_source": (str(src) if src is not None
+                                   else None)}
 
     def _phase_fields(self) -> Optional[dict]:
         if self.timers is None:
@@ -838,6 +861,16 @@ def summarize_metrics(records: list) -> dict:
                            if col("topology_epoch") else None),
         "remesh_count": (col("remesh_count")[-1]
                          if col("remesh_count") else None),
+        # mirror tier (schema v9): held redundancy bytes, total
+        # enqueue-side mirror cost, and the rung the last recovery
+        # restored from — mirror-attributed real-loss resumes show
+        # "mirror" here
+        "mirror_bytes": (max(col("mirror_bytes"))
+                         if col("mirror_bytes") else None),
+        "mirror_ms_total": (round(sum(col("mirror_ms")), 3)
+                            if col("mirror_ms") else None),
+        "restore_source": (col("restore_source")[-1]
+                           if col("restore_source") else None),
         # fleet batching (schema v3): member count + the
         # dispatch-amortization throughput metric
         "fleet_members": (col("fleet_members")[-1]

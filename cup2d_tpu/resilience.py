@@ -99,12 +99,21 @@ by the elastic subsystem (PR 7):
   ``topology_epoch`` / ``remesh_*`` field group.
 
 Real-pod coverage note: per-shard-local snapshots die with their host
-(an x-split state loses the lost host's columns), so a REAL host loss
-lands the disk rung by construction — the ring rung serves simulated
-topologies (all shards remain addressable) and any future
-host-redundant snapshot scheme. The 2-process drills are slow-marked
-(`tests/_multihost_worker.py`; the harness is environment-broken in
-this container, see ROADMAP).
+(an x-split state loses the lost host's columns). The host-redundant
+MIRRORED ring (PR 17) closes that gap: every capture additionally
+ships each host's shard block to its ring neighbor (io.MirroredSnapshot
+via parallel.mesh.host_ring_shift, checksummed on device), and
+``elastic_recover`` gains a mirrored-ring rung between the plain ring
+and disk — reconstruct the lost hosts' blocks from the survivors'
+mirrors (io.restore_snapshot_mirrored), re-shard, replay. The ladder
+is ring -> mirror -> disk -> abort; the mirror rung degrades to disk
+when the anchor carries no mirror (cadence staleness), the checksum
+rejects (``mirror_reject`` event), or a lost host's ring neighbor died
+with it. Drilled end-to-end on CPU with the destroyed-shard semantics
+(``shard_loss@N`` zeroes the dead host's slices first, so the resumed
+bytes provably came from the mirror); the 2-process real-runtime
+drills remain slow-marked (`tests/_multihost_worker.py`; the harness
+is environment-broken in this container, see ROADMAP).
 """
 
 from __future__ import annotations
@@ -187,6 +196,17 @@ class EventLog:
         import jax
         return (not dist_initialized()) or jax.process_index() == 0
 
+    # recovery-critical events are fsynced at emit: a process that dies
+    # right after a remesh (exactly the failure class the elastic path
+    # exists for) must not take the event trail post-mortem triage
+    # depends on into the page cache with it. Per-step metrics and
+    # routine events keep the cheap buffered write+flush path — fsync
+    # per step would serialize the dispatch pipeline on disk latency.
+    _DURABLE_EVENTS = frozenset({
+        "topology_lost", "remesh", "member_abort", "member_aborted",
+        "mirror_reject",
+    })
+
     def emit(self, **fields) -> None:
         if not self._is_writer():
             return
@@ -194,6 +214,11 @@ class EventLog:
         self._f.write(json.dumps(fields, sort_keys=True,
                                  default=float) + "\n")
         self._f.flush()
+        if fields.get("event") in self._DURABLE_EVENTS:
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass    # non-seekable sink (pipe/pty): flush is all it has
 
     def close(self) -> None:
         if not self._f.closed:
@@ -511,13 +536,26 @@ class StepGuard:
         dispatches step N+1, then pulls step N's set — the one batched
         ``device_get`` per step moves off the critical path. Host-diag
         drivers verdict eagerly either way.
+    mirror_hosts : host-ring size for the host-redundant mirrored
+        snapshot tier (None/<2 disables it — the default, bit-identical
+        to the pre-mirror guard). When set, every captured snapshot
+        additionally ships each host's shard block to its ring neighbor
+        (io.mirror_snapshot: one shard_map ppermute + on-device
+        checksums, enqueued before the next dispatch donates its
+        buffers — zero host transfers), and ``elastic_recover`` gains
+        the mirrored-ring rung between ring and disk.
+    mirror_every : mirror cadence in snapshots (``-mirrorEvery``): N > 1
+        mirrors every Nth capture — anchors between carry no mirror, so
+        a loss there finds the mirror rung stale and degrades to disk.
     """
 
     def __init__(self, sim, *, ring: int = 1, ckpt_dir: Optional[str] = None,
                  postmortem_dir: Optional[str] = None,
                  event_log: Optional[EventLog] = None,
                  faults=None, recover: bool = True, watchdog=None,
-                 snap_every: int = 1, lag: bool = True):
+                 snap_every: int = 1, lag: bool = True,
+                 mirror_hosts: Optional[int] = None,
+                 mirror_every: int = 1):
         self.sim = sim
         self.ring: deque = deque(maxlen=max(1, int(ring)))
         self.ckpt_dir = ckpt_dir
@@ -536,6 +574,17 @@ class StepGuard:
         self.topology_epoch = 0
         self.remesh_count = 0
         self.remesh_ms_total = 0.0
+        # host-redundant mirrored snapshot tier (PR 17, schema v9
+        # field group). mirror_hosts None/<2 keeps every mirror code
+        # path dormant — bit-identical dispatch stream to the
+        # pre-mirror guard, zero extra host syncs.
+        self.mirror_hosts = (int(mirror_hosts)
+                             if mirror_hosts and int(mirror_hosts) >= 2
+                             else None)
+        self.mirror_every = max(1, int(mirror_every))
+        self.mirror_ms_total = 0.0   # enqueue-side cost (telemetry)
+        self.restore_source = None   # last recovery rung: ring|mirror|disk
+        self._mirror_tick = 0
         self._pendings: list = []
         self._replay: list = []   # (dt, exact, trig) good steps since anchor
         self._since_snap = 0
@@ -554,8 +603,27 @@ class StepGuard:
 
     # -- snapshot machinery (device-resident, io.py) ------------------
     def _snapshot(self):
-        from .io import snapshot_state_device
-        return snapshot_state_device(self.sim)
+        from .io import snapshot_state_device, mirror_snapshot
+        snap = snapshot_state_device(self.sim)
+        mh = self.mirror_hosts
+        mesh = getattr(self.sim, "mesh", None)
+        if mh is not None and mesh is not None:
+            self._mirror_tick += 1
+            if self._mirror_tick >= self.mirror_every:
+                t0 = time.perf_counter()
+                m = mirror_snapshot(snap, mesh, mh)
+                if m is None:
+                    # unmirrorable family (forest payloads, odd
+                    # divisibility): latch the tier off rather than
+                    # re-probing every capture
+                    self.mirror_hosts = None
+                else:
+                    snap = snap._replace(mirror=m)
+                    self._mirror_tick = 0
+                # enqueue-side only — the collective itself overlaps
+                # with the next dispatch (async device execution)
+                self.mirror_ms_total += (time.perf_counter() - t0) * 1e3
+        return snap
 
     def ring_nbytes(self) -> int:
         """HBM footprint of every live snapshot (anchors + pending)."""
@@ -563,6 +631,22 @@ class StepGuard:
         n = sum(snapshot_nbytes(s) for s in self.ring)
         return n + sum(snapshot_nbytes(p.snap) for p in self._pendings
                        if p.snap is not None)
+
+    def mirror_nbytes(self) -> int:
+        """HBM footprint of the held mirror payloads (anchors +
+        pending) — the redundancy the host-redundant tier buys."""
+        from .io import mirror_nbytes
+        n = sum(mirror_nbytes(s) for s in self.ring)
+        return n + sum(mirror_nbytes(p.snap) for p in self._pendings
+                       if p.snap is not None)
+
+    def _held_mirror_snaps(self) -> list:
+        """Every held snapshot carrying a mirror, newest first (the
+        mirror_corrupt fault injector targets the newest)."""
+        out = [p.snap for p in reversed(self._pendings)
+               if p.snap is not None and p.snap.mirror is not None]
+        out += [s for s in reversed(self.ring) if s.mirror is not None]
+        return out
 
     @property
     def pending(self) -> bool:
@@ -695,6 +779,17 @@ class StepGuard:
             pend.snap = self._snapshot()
             self._since_snap = 0
         self._pendings.append(pend)
+        # fault injection: mirror_corrupt@N flips bytes in EVERY held
+        # mirror so the recovery-time checksum-reject path is drillable
+        # regardless of which anchor the next loss lands on (suspended
+        # during replay like every other token; keyed on the pre-step
+        # count like apply_pre_step)
+        if self.faults is not None \
+                and getattr(self.faults, "mirror_corrupt", None) \
+                and self.faults.mirror_corrupt_at(step0):
+            from .io import corrupt_mirror
+            for s in self._held_mirror_snaps():
+                corrupt_mirror(s)
 
     def _resolve_oldest(self) -> dict:
         pend = self._pendings.pop(0)
@@ -988,29 +1083,90 @@ class StepGuard:
            ``sim.remesh`` rebuilds placement/tables/step executables
            over it (the SFC block partition is device-count-parametric,
            so the forest re-partitions by construction);
-        3. state: the latest ring anchor where its shards still cover
-           the survivor set (``io.snapshot_covers`` — re-sharded onto
-           the new mesh by ``io.restore_snapshot_resharded``, then the
-           recorded steps since the anchor replayed on the new mesh),
-           else the last disk checkpoint, else abort through the
-           standard post-mortem machinery.
+        3. state, down a four-rung ladder:
+
+           - **ring** — the latest anchor whose OWN shards still cover
+             the survivor set (``io.snapshot_covers`` with the mirror
+             tier masked off; a shard_loss drill voids this rung by
+             construction — the owner bytes are destroyed) —
+             re-sharded onto the new mesh by
+             ``io.restore_snapshot_resharded``, then the recorded
+             steps since the anchor replayed on the new mesh;
+           - **mirror** — the anchor carries a host-redundant mirror
+             and every lost host's ring neighbor survived
+             (mirror-aware ``snapshot_covers``): the neighbor-held
+             blocks are checksum-verified (``io.verify_mirror``; a
+             torn/corrupt mirror emits one ``mirror_reject`` event and
+             falls through rather than installing bad bytes),
+             realigned over the lost columns
+             (``io.restore_snapshot_mirrored``), and replayed exactly
+             like the ring rung — same trajectory, in-HBM resume;
+           - **disk** — the last checkpoint, watchdog baseline reset;
+           - **abort** — standard post-mortem machinery.
 
         The ring is re-anchored on the new topology afterwards (old
-        entries carry lost-mesh placement and must never be restored).
+        entries carry lost-mesh placement and must never be restored),
+        and the mirror tier is resized to the surviving host count
+        (disabled when fewer than two hosts remain — no neighbor left
+        to hold a mirror).
         """
         import time as _time
 
         sim = self.sim
         t0 = _time.perf_counter()
+        import jax
+        if jax.default_backend() == "cpu":
+            # recovery fence, CPU ONLY: dispatched-but-unverdicted
+            # steps may still be executing, and their halo collectives
+            # share devices with the recovery launches (verify sums,
+            # mirror realign). The CPU client honors no cross-launch
+            # device order, so racing them can deadlock at rendezvous
+            # (io.mirror_snapshot documents the capture-side twin).
+            # Settle everything in flight before the first recovery
+            # launch; TPU's enqueue-ordered streams don't need this.
+            for a in jax.tree_util.tree_leaves(
+                    [(p.snap, p.diag) for p in self._pendings]
+                    + [getattr(sim, "state", None)]):
+                if hasattr(a, "block_until_ready"):
+                    a.block_until_ready()
         # stage 1: discard + refund (the ladder's garbage-dispatch rule)
         self._discard_pendings()
         survivors = topo.survivor_devices()
-        anchor = self.ring[-1] if self.ring else None
         from .io import load_checkpoint, restore_snapshot_resharded, \
-            snapshot_covers
-        use_ring = anchor is not None and snapshot_covers(
-            anchor, topo.lost_process_indices())
-        if not use_ring and not self._disk_available():
+            restore_snapshot_mirrored, snapshot_covers, verify_mirror, \
+            destroy_shards
+        # real-loss honesty (shard_loss drill): zero the destroyed
+        # hosts' shard slices — live state, every held snapshot payload
+        # AND the physical mirror slices they held — BEFORE choosing a
+        # rung, so a successful resume provably sourced the survivors'
+        # mirror copies, not the "lost" originals
+        destroyed = tuple(topo.destroyed_hosts())
+        lost_hosts = tuple(topo.lost_host_indices())
+        if destroyed:
+            wiped = destroy_shards(sim, list(self.ring), destroyed,
+                                   topo.n_hosts)
+            self.ring.clear()
+            self.ring.extend(wiped)
+        anchor = self.ring[-1] if self.ring else None
+        lost_p = topo.lost_process_indices()
+        use_ring = anchor is not None and not destroyed \
+            and snapshot_covers(anchor, lost_p, mirror=False)
+        use_mirror = False
+        if not use_ring and anchor is not None and snapshot_covers(
+                anchor, lost_p, lost_hosts=lost_hosts,
+                shards_destroyed=bool(destroyed)):
+            dead = tuple(sorted(set(lost_hosts) | set(lost_p)))
+            bad = verify_mirror(anchor, dead)
+            if bad:
+                # torn/corrupt mirror: never install it — reject loudly
+                # (durable event) and fall through to disk
+                if self.event_log is not None:
+                    self.event_log.emit(
+                        event="mirror_reject", step=int(sim.step_count),
+                        n_rejects=len(bad), rejects=bad[:8])
+            else:
+                use_mirror = True
+        if not use_ring and not use_mirror and not self._disk_available():
             v = StepVerdict(False, "topology_lost")
             self._abort(sim.step_count, v,
                         {}, float("nan"))
@@ -1028,15 +1184,26 @@ class StepGuard:
             restore_snapshot_resharded(sim, anchor)
             replayed = self._replay_recorded()
             source = "ring"
+        elif use_mirror:
+            dead = tuple(sorted(set(lost_hosts) | set(lost_p)))
+            restore_snapshot_mirrored(sim, anchor, dead)
+            replayed = self._replay_recorded()
+            source = "mirror"
         else:
             load_checkpoint(self.ckpt_dir, sim)
             if self.watchdog is not None:
                 # the window describes steps forward of the restored
                 # point — stale as a baseline (same rule as the ladder's
-                # disk rung; the ring path resumes the SAME trajectory,
-                # so its window stays valid)
+                # disk rung; the ring/mirror paths resume the SAME
+                # trajectory, so its window stays valid)
                 self.watchdog.reset()
             source = "disk"
+        self.restore_source = source
+        # resize the mirror tier to the surviving hosts: below two
+        # there is no neighbor left to hold a mirror
+        if self.mirror_hosts is not None:
+            alive = topo.alive_host_count()
+            self.mirror_hosts = alive if alive >= 2 else None
         self.ring.clear()
         self._reanchor()
         self.topology_epoch = int(topo.epoch)
@@ -1562,6 +1729,10 @@ class TopologyGuard:
         self.alive = [True] * n
         self._dead: dict = {}      # host -> fault kind (not yet declared)
         self._missed: dict = {}    # host -> consecutive missed beats
+        # hosts whose shard slices died WITH them (shard_loss@N paired
+        # with the loss token — the simulated real-loss semantics; real
+        # process losses carry this implicitly via lost_process_indices)
+        self._destroyed: set = set()
 
     # -- topology bookkeeping -----------------------------------------
     @property
@@ -1589,6 +1760,24 @@ class TopologyGuard:
         ``io.snapshot_covers``."""
         return tuple(sorted(self._lost_processes))
 
+    def lost_host_indices(self) -> tuple:
+        """Ring indices of every declared-lost host, BOTH modes (the
+        mirror-coverage input: simulated hosts and real processes ride
+        the same contiguous-block ring)."""
+        return tuple(h for h in range(len(self.alive))
+                     if not self.alive[h])
+
+    def destroyed_hosts(self) -> tuple:
+        """Declared-lost hosts whose shard slices died with them
+        (``shard_loss@N`` consumed at the loss boundary) — the
+        simulated real-loss set ``elastic_recover`` zeroes via
+        ``io.destroy_shards`` before choosing a resume rung."""
+        return tuple(sorted(h for h in self._destroyed
+                            if not self.alive[h]))
+
+    def alive_host_count(self) -> int:
+        return sum(1 for a in self.alive if a)
+
     # -- detection -----------------------------------------------------
     def poll(self, step: int) -> tuple:
         """One simulated-mode heartbeat at the boundary of ``step``:
@@ -1601,6 +1790,11 @@ class TopologyGuard:
                 h = self._highest_alive_undead()
                 if h is not None:
                     self._dead[h] = kind
+                    if self.faults.shard_loss_at(step):
+                        # the loss takes its shard slices with it (the
+                        # simulated real-loss semantics; zeroed by
+                        # elastic_recover via io.destroy_shards)
+                        self._destroyed.add(h)
         newly = []
         for h, kind in self._dead.items():
             if not self.alive[h]:

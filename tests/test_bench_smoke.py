@@ -38,6 +38,8 @@ def test_bench_cpu_smoke():
         BENCH_SERVE_SIZE="16",
         BENCH_SERVE_MEMBERS="4",
         BENCH_SERVE_STEPS="8",
+        BENCH_MIRROR_SIZE="32",          # mirror-overhead point, tiny
+        BENCH_MIRROR_ITERS="5",
         BENCH_POISSON_SIZE="32",         # tiny solver micro-curve
         BENCH_KERNEL_SIZE="32",          # kernel-tier curve, interpret mode
         BENCH_KERNEL_REPS="1",
@@ -82,6 +84,16 @@ def test_bench_cpu_smoke():
     assert 0 < srv["occupancy_mean"] <= 1, srv
     assert srv["admitted"] > srv["retired"] >= 4, srv
     assert srv["evicted"] == 0, srv
+    # mirror-overhead point (PR 17): the host-redundant snapshot tier
+    # measured on the bench's 2 forced virtual devices grouped into 2
+    # hosts — present, no error, sane values (non-negative overhead,
+    # positive redundancy bytes)
+    mr = out["mirror"]
+    assert "error" not in mr, mr
+    assert mr["devices"] == 2 and mr["hosts"] == 2
+    assert mr["snap_ms"] > 0 and mr["snap_mirror_ms"] > 0, mr
+    assert mr["mirror_overhead_ms"] >= 0, mr
+    assert mr["mirror_bytes"] > 0 and mr["snapshot_bytes"] > 0, mr
     # Poisson solve-path micro-curve (PR 6): every path present with a
     # real converged solve, so the solver trajectory is tracked in the
     # BENCH JSON across rounds

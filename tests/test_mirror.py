@@ -1,0 +1,410 @@
+"""Host-redundant mirrored snapshot ring (PR 17): in-HBM recovery
+from REAL host loss.
+
+PR 7's elastic drill proves ring resume when the lost host's shards
+survive (a simulated loss loses no process, so the DeviceSnapshot
+still covers). A REAL loss takes its shard bytes with it — pre-PR-17
+every real loss landed the slow disk rung. This file drills the new
+mirrored-ring rung honestly on CPU: the ``shard_loss@N`` fault ZEROES
+the dead host's shard slices (live state, every ring payload, and the
+mirror slices it physically held — io.destroy_shards) before recovery
+runs, so a resumed trajectory that matches the from-checkpoint
+reference to <= 1e-12 provably came from the NEIGHBOR's mirror, not
+the "lost" originals.
+
+Coverage: the two new fault tokens (grammar + consumption +
+suspension), the mirror exchange identity (one host-granular ppermute
+== roll(+Nx/H) — parallel/mesh.host_ring_shift), checksum
+verify/corrupt/destroy unit semantics, mirror-aware snapshot_covers
+(owner OR surviving mirror holder; neighbor-also-dead uncovered), THE
+destroyed-shard drill (mirror rung, restore_source attribution in
+schema v9 metrics, trajectory pin), the corrupt-mirror degrade-to-disk
+drill (checksum-reject event, never installs torn bytes), the
+mirror-off bit-identity + zero-extra-host-sync contract, and the
+durable-event fsync satellite.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cup2d_tpu.config import SimConfig
+from cup2d_tpu.faults import FaultPlan
+from cup2d_tpu.io import (corrupt_mirror, destroy_shards, load_checkpoint,
+                          mirror_nbytes, mirror_snapshot, save_checkpoint,
+                          snapshot_covers, snapshot_state_device,
+                          verify_mirror)
+from cup2d_tpu.parallel.mesh import (ShardedUniformSim, host_ring_shift,
+                                     make_mesh)
+from cup2d_tpu.profiling import (HostCounters, MetricsRecorder,
+                                 summarize_metrics)
+from cup2d_tpu.resilience import (EventLog, PreemptionGuard, StepGuard,
+                                  TopologyGuard)
+from cup2d_tpu.uniform import taylor_green_state
+
+
+def _cfg(**kw):
+    base = dict(bpdx=2, bpdy=1, level_max=1, level_start=0, extent=2.0,
+                nu=1e-3, cfl=0.4, dtype="float64",
+                max_poisson_iterations=200)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _sharded(mesh, level=2):
+    sim = ShardedUniformSim(_cfg(), mesh, level=level)
+    sim.set_state(taylor_green_state(sim.grid))
+    sim.step_count = 20     # production regime (test_elastic pattern)
+    return sim
+
+
+def _events(path, kind=None):
+    with open(path) as f:
+        evs = [json.loads(ln) for ln in f if ln.strip()]
+    return [e for e in evs if kind is None or e.get("event") == kind]
+
+
+# ---------------------------------------------------------------------------
+# fault grammar: the real-loss and corruption tokens
+# ---------------------------------------------------------------------------
+
+def test_mirror_fault_grammar():
+    plan = FaultPlan("shard_loss@5,mirror_corrupt@7")
+    assert plan                       # the new tokens arm the plan
+    assert plan.shard_loss == {5: 1}
+    assert plan.mirror_corrupt == {7: 1}
+    # consumed exactly once
+    assert plan.shard_loss_at(4) is False
+    assert plan.shard_loss_at(5) is True
+    assert plan.shard_loss_at(5) is False
+    # suspended during guard replay like every other injector
+    with plan.suspend():
+        assert plan.mirror_corrupt_at(7) is False
+    assert plan.mirror_corrupt_at(7) is True
+    assert plan.mirror_corrupt_at(7) is False
+    # a typo'd directive raises instead of silently arming nothing
+    with pytest.raises(ValueError):
+        FaultPlan("shard_loss")           # needs @STEP
+    with pytest.raises(ValueError):
+        FaultPlan("mirror_corrupt")       # needs @STEP
+    with pytest.raises(ValueError):
+        FaultPlan("shard_lost@3")         # unknown token
+
+
+# ---------------------------------------------------------------------------
+# unit semantics: exchange identity, checksums, coverage, destruction
+# ---------------------------------------------------------------------------
+
+def test_host_ring_shift_is_roll():
+    """The mirror exchange is exactly roll(+Nx/H) — the restore side
+    (io.restore_snapshot_mirrored) relies on this identity to realign
+    the neighbor-held blocks over the lost columns."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = make_mesh(devices=jax.devices()[:4])
+    x = jnp.arange(4 * 16, dtype=jnp.float64).reshape(4, 16)
+    xs = jax.device_put(x, NamedSharding(mesh, P(None, "x")))
+    y = host_ring_shift(xs, mesh, 2)
+    assert np.array_equal(np.asarray(y), np.roll(np.asarray(x), 8, -1))
+    with pytest.raises(ValueError):
+        host_ring_shift(xs, mesh, 1)      # no ring below 2 hosts
+    with pytest.raises(ValueError):
+        host_ring_shift(xs, mesh, 3)      # 3 does not divide 4 devices
+
+
+def test_mirror_checksums_and_coverage():
+    mesh = make_mesh(devices=jax.devices()[:4])
+    sim = _sharded(mesh)
+    snap = snapshot_state_device(sim)
+    m = mirror_snapshot(snap, mesh, 2)
+    assert m is not None and m.n_hosts == 2
+    snap = snap._replace(mirror=m)
+    assert mirror_nbytes(snap) > 0
+    # the mirrored columns are the roll of the originals
+    vel = np.asarray(snap.payload["vel"])
+    assert np.array_equal(np.asarray(m.payload["vel"]),
+                          np.roll(vel, vel.shape[-1] // 2, -1))
+    # clean mirror verifies for either lost host
+    assert verify_mirror(snap, (0,)) == []
+    assert verify_mirror(snap, (1,)) == []
+    # coverage: a simulated loss with DESTROYED shards voids the owner
+    # rung but the surviving neighbor's mirror covers
+    assert snapshot_covers(snap, lost_hosts=(1,), shards_destroyed=True)
+    assert not snapshot_covers(snap, lost_hosts=(1,),
+                               shards_destroyed=True, mirror=False)
+    # neighbor-also-died: host 0's mirror lives on host 1 — both dead
+    # means nothing holds the bytes, mirror coverage must refuse
+    assert not snapshot_covers(snap, lost_hosts=(0, 1),
+                               shards_destroyed=True)
+    # no mirror captured -> destroyed shards are simply gone
+    bare = snapshot_state_device(sim)
+    assert not snapshot_covers(bare, lost_hosts=(1,),
+                               shards_destroyed=True)
+
+    # corruption: one flipped element per host block is DETECTED (the
+    # injector flips exactly one so an even-count cancellation mod 2^32
+    # can never mask it), and only then
+    assert corrupt_mirror(snap) is True
+    bad = verify_mirror(snap, (1,))
+    assert bad and all(r["expected"] != r["actual"] for r in bad)
+    fields = {r["field"] for r in bad}
+    assert "vel" in fields and "pres" in fields
+    assert corrupt_mirror(bare) is False      # nothing to corrupt
+
+    # destruction: the dead host's slices are zeroed everywhere — the
+    # live state, the snapshot payload, and the mirror slices the dead
+    # host physically held (host 0's block mirrors onto host 1, so
+    # killing host 1 wipes host 0's mirror copy too)
+    snap2 = snapshot_state_device(sim)
+    snap2 = snap2._replace(mirror=mirror_snapshot(snap2, mesh, 2))
+    [wiped] = destroy_shards(sim, [snap2], (1,), 2)
+    nx = vel.shape[-1]
+    lost = np.s_[..., nx // 2:]
+    surv = np.s_[..., :nx // 2]
+    assert np.all(np.asarray(sim.state.vel)[lost] == 0)
+    assert np.all(np.asarray(wiped.payload["vel"])[lost] == 0)
+    assert np.any(np.asarray(wiped.payload["vel"])[surv] != 0)
+    # the mirror array's PHYSICAL lost-host slice is zeroed — which
+    # holds host 0's (rolled) copy; host 1's own copy lives on host 0
+    # and survives
+    assert np.all(np.asarray(wiped.mirror.payload["vel"])[lost] == 0)
+    assert np.any(np.asarray(wiped.mirror.payload["vel"])[surv] != 0)
+    # and the surviving blocks still checksum clean (per-block sums —
+    # a whole-array sum would have been invalidated by the wipe)
+    assert verify_mirror(wiped, (1,)) == []
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance drill: destroyed shards, mirror-rung resume, restart pin
+# ---------------------------------------------------------------------------
+
+def test_elastic_drill_destroyed_shards_mirror_rung(tmp_path):
+    """A 4-device / 2-simulated-host run REALLY loses host 1 at step
+    27: host_exit@27 declares the loss and shard_loss@27 zeroes every
+    byte the dead host held before recovery runs. The owner rung is
+    provably void, so the guard resumes from the NEIGHBOR's mirror
+    (remesh event source="mirror"), the continued trajectory matches a
+    from-checkpoint restart on the shrunk mesh <= 1e-12, and the
+    recovery is attributable from metrics.jsonl alone (schema v9:
+    restore_source="mirror", mirror_bytes > 0)."""
+    devs = jax.devices()[:4]
+    mesh4 = make_mesh(devices=devs)
+    events_path = str(tmp_path / "events.jsonl")
+    metrics_path = str(tmp_path / "metrics.jsonl")
+    log = EventLog(events_path)
+    metrics_log = EventLog(metrics_path)
+    ck = str(tmp_path / "ck")
+
+    plan = FaultPlan("host_exit@27,shard_loss@27")
+    topo = TopologyGuard(devices=devs, sim_hosts=2, miss_k=1,
+                         faults=plan, event_log=log)
+    sim = _sharded(mesh4)
+    # construction-time solver-trigger state, restored for the
+    # reference leg below so the restart is trigger-identical to a
+    # fresh driver
+    trig0 = {a: getattr(sim, a) for a in
+             ("_coarse_on", "_last_iters", "_last_iters_dev")
+             if hasattr(sim, a)}
+    guard = StepGuard(sim, ckpt_dir=ck, event_log=log, faults=plan,
+                      snap_every=1, mirror_hosts=2)
+    recorder = MetricsRecorder(sink=metrics_log, guard=guard)
+    recorder.prime(sim)
+    stop = PreemptionGuard()
+
+    def record(rec):
+        if rec is not None:
+            recorder.record_step(step=rec["step"], t=rec["t"],
+                                 dt=rec["dt"], diag=rec, sim=sim)
+
+    saved = False
+    while sim.step_count < 32:
+        if not saved and sim.step_count == 26:
+            for rec in guard.drain():
+                record(rec)
+            save_checkpoint(ck, sim)
+            saved = True
+        beat = topo.step_boundary(stop, sim.step_count)
+        assert not beat.hung and not beat.self_lost
+        if beat.lost:
+            guard.elastic_recover(topo)
+            continue
+        record(guard.step())
+    for rec in guard.drain():
+        record(rec)
+    log.close()
+    metrics_log.close()
+
+    # the loss really happened in place, on the survivor mesh
+    assert sim.mesh.devices.size == 2 and sim.step_count == 32
+    assert guard.restore_source == "mirror"
+    # mirror tier resized to the 1 surviving host -> disabled
+    assert guard.mirror_hosts is None
+
+    remesh_evs = _events(events_path, "remesh")
+    assert len(remesh_evs) == 1
+    assert remesh_evs[0]["source"] == "mirror"    # the new rung
+    assert remesh_evs[0]["step"] == 26            # the checkpoint anchor
+    assert _events(events_path, "mirror_reject") == []
+
+    # schema v9 attribution from the metrics stream alone
+    with open(metrics_path) as f:
+        ms = [json.loads(ln) for ln in f if ln.strip()]
+    pre = [m for m in ms if m["topology_epoch"] == 0]
+    post = [m for m in ms if m["topology_epoch"] == 1]
+    assert pre and post
+    assert all(m["restore_source"] is None for m in pre)
+    assert all(m["restore_source"] == "mirror" for m in post)
+    assert any(m["mirror_bytes"] and m["mirror_bytes"] > 0 for m in pre)
+    assert any(m["mirror_ms"] and m["mirror_ms"] > 0 for m in pre)
+    # ... and post --metrics surfaces the rung (summarize_metrics is
+    # exactly what the CLI report prints)
+    summary = summarize_metrics(ms)
+    assert summary["restore_source"] == "mirror"
+    assert summary["mirror_bytes"] > 0
+
+    # the reference: a from-checkpoint restart on the shrunk mesh —
+    # the resumed trajectory must match to <= 1e-12. The restart
+    # reuses the SAME (already remeshed + compiled) sim rather than a
+    # fresh 2-device driver: load_checkpoint scrubs the dt chain and
+    # trig0 resets the solver trigger, so the leg is state-identical
+    # to a fresh restart without paying a second 2-device step compile
+    # on the 1-core CI box.
+    final_vel, final_pres = jax.device_get((sim.state.vel,
+                                            sim.state.pres))
+    final_t = float(sim.time)
+    load_checkpoint(ck, sim)
+    for a, v in trig0.items():
+        setattr(sim, a, v)
+    gref = StepGuard(sim, snap_every=1)
+    while sim.step_count < 32:
+        gref.step()
+    gref.drain()
+    assert sim.step_count == 32
+    assert abs(sim.time - final_t) <= 1e-12
+    for a, b in ((final_vel, sim.state.vel),
+                 (final_pres, sim.state.pres)):
+        assert np.max(np.abs(np.asarray(a) - np.asarray(b))) <= 1e-12
+
+
+# ---------------------------------------------------------------------------
+# degrade path: corrupt mirror -> checksum reject -> disk rung
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow   # ~60 s on the 1-core CI box (its own 4-device +
+#                     2-device step compiles); the reject DETECTION is
+#                     tier-1 via test_mirror_checksums_and_coverage
+#                     (corrupt -> verify_mirror names the bad blocks),
+#                     and the rung-choice plumbing this adds is pure
+#                     host Python — same budget rule as the repo's
+#                     other slow end-to-end drills
+def test_corrupt_mirror_degrades_to_disk(tmp_path):
+    """Same destroyed-shard loss, but the held mirrors are corrupted
+    (mirror_corrupt@26 — fired at the dispatch right after the
+    checkpoint, so the recovery's anchor carries flipped bytes). The
+    rung must DETECT the corruption (one mirror_reject event naming
+    the rejected blocks), refuse to install it, and degrade to the
+    disk checkpoint — never silently resume from torn bytes."""
+    devs = jax.devices()[:4]
+    events_path = str(tmp_path / "events.jsonl")
+    log = EventLog(events_path)
+    ck = str(tmp_path / "ck")
+
+    plan = FaultPlan("mirror_corrupt@26,host_exit@27,shard_loss@27")
+    topo = TopologyGuard(devices=devs, sim_hosts=2, miss_k=1,
+                         faults=plan, event_log=log)
+    sim = _sharded(make_mesh(devices=devs))
+    guard = StepGuard(sim, ckpt_dir=ck, event_log=log, faults=plan,
+                      snap_every=1, mirror_hosts=2)
+    stop = PreemptionGuard()
+
+    saved = False
+    while sim.step_count < 30:
+        if not saved and sim.step_count == 26:
+            guard.drain()
+            save_checkpoint(ck, sim)
+            saved = True
+        beat = topo.step_boundary(stop, sim.step_count)
+        if beat.lost:
+            guard.elastic_recover(topo)
+            continue
+        guard.step()
+    guard.drain()
+    log.close()
+
+    assert guard.restore_source == "disk"
+    rejects = _events(events_path, "mirror_reject")
+    assert len(rejects) == 1 and rejects[0]["n_rejects"] > 0
+    assert rejects[0]["rejects"][0]["expected"] != \
+        rejects[0]["rejects"][0]["actual"]
+    remesh_evs = _events(events_path, "remesh")
+    assert len(remesh_evs) == 1 and remesh_evs[0]["source"] == "disk"
+    # the run continued past the degrade — recovery completed
+    assert sim.step_count == 30 and sim.mesh.devices.size == 2
+    assert np.all(np.isfinite(np.asarray(sim.state.vel)))
+
+
+# ---------------------------------------------------------------------------
+# the -noMirror contract: bit-identical, zero extra host syncs
+# ---------------------------------------------------------------------------
+
+def test_mirror_off_bit_identical_zero_extra_syncs():
+    """The mirror tier must be invisible to the trajectory and to the
+    host-sync discipline: a mirror-ON run produces bit-identical state
+    to a mirror-OFF run with EQUAL device_get counts (capture-side
+    mirroring is pure device collectives — ppermute + on-device
+    checksums; the one checksum pull lives on the cold recovery path
+    only). One sim object serves both runs so the comparison shares
+    every compiled executable."""
+    mesh = make_mesh(devices=jax.devices()[:4])
+    sim = ShardedUniformSim(_cfg(), mesh, level=2)
+
+    def run(mirror_hosts):
+        sim.set_state(taylor_green_state(sim.grid))
+        sim.step_count, sim.time = 20, 0.0
+        sim._next_dt = None           # reset the cached dt chain
+        guard = StepGuard(sim, mirror_hosts=mirror_hosts, snap_every=1)
+        counters = HostCounters().install()
+        try:
+            while sim.step_count < 26:
+                guard.step()
+            guard.drain()
+        finally:
+            counters.uninstall()
+        if mirror_hosts:
+            assert guard.mirror_nbytes() > 0   # the tier really ran
+        else:
+            assert guard.mirror_nbytes() == 0
+        return (jax.device_get(sim.state), counters.device_gets)
+
+    state_off, gets_off = run(None)
+    state_on, gets_on = run(2)
+    for a, b in zip(state_off, state_on):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+    assert gets_off == gets_on
+
+
+# ---------------------------------------------------------------------------
+# satellite: recovery-critical events are fsynced at emit
+# ---------------------------------------------------------------------------
+
+def test_durable_events_fsynced_at_emit(tmp_path):
+    """topology_lost / remesh / member_abort / mirror_reject must hit
+    the disk AT EMIT — a crash right after a remesh must not lose the
+    event trail post-mortem triage depends on. (Plain per-step metrics
+    keep the cheap buffered-flush path; durability there costs an
+    fsync per step for data that is reconstructible.)"""
+    from cup2d_tpu.resilience import EventLog as EL
+    assert {"topology_lost", "remesh", "member_abort",
+            "mirror_reject"} <= set(EL._DURABLE_EVENTS)
+    path = str(tmp_path / "events.jsonl")
+    log = EL(path)
+    log.emit(event="remesh", epoch=1, source="mirror")
+    # WITHOUT closing: the line must already be durable on disk
+    with open(path) as f:
+        evs = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(evs) == 1 and evs[0]["event"] == "remesh"
+    assert evs[0]["source"] == "mirror"
+    log.close()

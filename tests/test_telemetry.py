@@ -71,8 +71,10 @@ def _amr_sim():
 # slot-pool lifecycle, fleet.py); v8 the boundary-condition attribution
 # pair (bc_table — the driver's BCTable token, e.g. "fs,fs,fs,fs" —
 # and case, the case-registry tag or null for ad-hoc runs, bc.py +
-# cases.py).
-_SCHEMA_V8_KEYS = (
+# cases.py); v9 the host-redundant mirror-tier group (mirror_bytes /
+# mirror_ms / restore_source — the neighbor-mirrored snapshot ring and
+# the rung attribution of elastic recoveries, PR 17).
+_SCHEMA_V9_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     "umax", "dt_next",
     "poisson_iters", "poisson_residual",
@@ -86,6 +88,7 @@ _SCHEMA_V8_KEYS = (
     "jit_compiles", "device_gets", "state_gathers", "hbm_peak_bytes",
     "snap_ring_bytes", "replayed_steps",
     "topology_epoch", "remesh_count", "remesh_ms",
+    "mirror_bytes", "mirror_ms", "restore_source",
     "fleet_members", "member_steps_per_s", "member_health",
     "active_members", "occupancy", "admitted", "evicted",
     "queue_depth",
@@ -93,15 +96,15 @@ _SCHEMA_V8_KEYS = (
 )
 
 
-def test_metrics_schema_v8_key_set_pinned():
+def test_metrics_schema_v9_key_set_pinned():
     from cup2d_tpu.profiling import METRICS_SCHEMA_VERSION
-    assert METRICS_SCHEMA_VERSION == 8
-    assert METRICS_KEYS == _SCHEMA_V8_KEYS
+    assert METRICS_SCHEMA_VERSION == 9
+    assert METRICS_KEYS == _SCHEMA_V9_KEYS
 
 
 @pytest.mark.slow   # ~17 s; duplicative tier-1 coverage: the frozen key
 #                     SET is pinned as a literal tuple in
-#                     test_metrics_schema_v8_key_set_pinned and the
+#                     test_metrics_schema_v9_key_set_pinned and the
 #                     uniform producer stream (every record, key-exact)
 #                     in test_cli_metrics_stream_and_post_report; the
 #                     AMR/bench records drilled here ride the identical
