@@ -482,6 +482,7 @@ def run_fleet_serving(size: int, members: int = 8, n_steps: int = 60,
     from cup2d_tpu.fleet import (FleetRequest, FleetServer, FleetSim,
                                  taylor_green_fleet)
     from cup2d_tpu.profiling import HostCounters
+    from cup2d_tpu.tracing import ServingLatency
     from cup2d_tpu.uniform import FlowState
 
     level = int(np.log2(size // 8))
@@ -509,7 +510,10 @@ def run_fleet_serving(size: int, members: int = 8, n_steps: int = 60,
     # reported by the production run's phase timers instead).
     sim2 = FleetSim(cfg, level=level, members=members)
     sim2.step_count = 20
-    server = FleetServer(sim2)
+    # latency histograms (tracing.ServingLatency) ride the server's
+    # existing submit/admit/step boundaries — pure host clocks, so the
+    # instrument itself costs nothing the timed window can see
+    server = FleetServer(sim2, latency=ServingLatency())
     ens = taylor_green_fleet(sim2.grid, members)   # session state bank
     n_req = 0
     queued_msteps = 0
@@ -591,11 +595,18 @@ def run_fleet_serving(size: int, members: int = 8, n_steps: int = 60,
         "retired": server.retired,
         "evicted": server.evicted,
         "recompiles_after_warmup": recompiles,
+        # pool-wide latency distributions of the whole churn run
+        # (warmup included — queue_wait/admit percentiles need the
+        # admission waves, not just the steady window)
+        "serving_latency": server.latency.report()["pool"],
         "note": ("serving member-steps/s is occupancy-weighted (sum "
                  "of live members over the churn window / wall); the "
                  "ratio vs the static fixed-B loop prices the serving "
                  "machinery, and recompiles_after_warmup pins the "
-                 "zero-steady-state-recompile contract"),
+                 "zero-steady-state-recompile contract; "
+                 "serving_latency is the pool-wide queue-wait/"
+                 "admit-to-first-step/per-step histogram report "
+                 "(log2 buckets, tracing.LatencyHistogram)"),
     }
 
 

@@ -111,6 +111,15 @@ invariants feed a drift watchdog wired into the recovery ladder
 Knobs: ``-noMetrics``, ``-metricsLog PATH``, ``-noWatchdog``. Windowed
 device tracing: ``CUP2D_TRACE=start:stop[:logdir]`` wraps exactly those
 steps in a ``jax.profiler`` TensorBoard trace.
+
+THE FLIGHT RECORDER (tracing.py, PR 18) rides the same zero-extra-sync
+discipline: span timeline (``<output>/spans.jsonl``, export with
+``python -m cup2d_tpu.post --trace``), compile/HBM ledger and serving
+latency histograms (both summarized into ``metrics.jsonl`` at exit).
+Knobs: ``-noSpans``, ``-spansLog PATH``, ``-noMemLedger``,
+``-logRotateMB N`` (size-capped rotation of metrics/clients/spans
+JSONL streams — default off), ``CUP2D_SPANS=0|N`` (disable spans /
+ring capacity, latched once).
 """
 
 from __future__ import annotations
@@ -152,6 +161,9 @@ def main(argv=None) -> int:
     ckpt_every = p("checkpointEvery").asInt() if p.has("checkpointEvery") \
         else 0
     max_steps = p("maxSteps").asInt() if p.has("maxSteps") else 10**9
+    # size-capped JSONL rotation (metrics/clients/spans) — default off;
+    # long serving runs cap each stream at N MB per segment
+    rotate_mb = p("logRotateMB").asInt() if p.has("logRotateMB") else None
     os.makedirs(outdir, exist_ok=True)
 
     from . import faults
@@ -371,11 +383,18 @@ def main(argv=None) -> int:
     if serve_n:
         from .fleet import (FleetRequest, FleetServer, FlowState,
                             taylor_green_fleet)
+        serving_lat = None
+        if not p.has("noMetrics"):
+            # latency histograms ride the server's existing submit/
+            # admit/step boundaries — host clocks only
+            from .tracing import ServingLatency
+            serving_lat = ServingLatency()
         server = FleetServer(
             sim, guard=guard,
             session_dir=os.path.join(outdir, "sessions"),
             event_log=log,
-            clients_dir=os.path.join(outdir, "clients"))
+            clients_dir=os.path.join(outdir, "clients"),
+            clients_rotate_mb=rotate_mb, latency=serving_lat)
         # the session ladder: Taylor-Green at geometrically decaying
         # amplitudes (per-session umax -> per-session dt) with horizons
         # staggered across [tend/2, tend] so retirements interleave
@@ -400,14 +419,30 @@ def main(argv=None) -> int:
     metrics_log = None
     recorder = None
     counters = None
+    flight = None
+    spans_log = None
     if not p.has("noMetrics"):
         metrics_path = p("metricsLog").asString() if p.has("metricsLog") \
             else os.path.join(outdir, "metrics.jsonl")
-        metrics_log = EventLog(metrics_path)
+        metrics_log = EventLog(metrics_path, rotate_mb=rotate_mb)
         counters = HostCounters().install()
+        # flight recorder: span timeline (spans.jsonl, per process),
+        # compile/HBM ledger (summarized into metrics.jsonl at exit).
+        # Zero new device pulls — the zero-overhead contract is pinned
+        # by tests/test_tracing.py (bit-identical, equal device_gets,
+        # equal jit_compiles)
+        from .tracing import FlightRecorder
+        spans_path = p("spansLog").asString() if p.has("spansLog") \
+            else os.path.join(outdir, "spans.jsonl")
+        spans_log = EventLog(spans_path, rotate_mb=rotate_mb,
+                             all_writers=True)
+        flight = FlightRecorder.from_env(
+            spans=not p.has("noSpans"),
+            capture_memory=not p.has("noMemLedger"),
+            sink=spans_log).install()
         recorder = MetricsRecorder(sink=metrics_log, counters=counters,
                                    timers=sim.timers, guard=guard,
-                                   server=server)
+                                   server=server, flight=flight)
         recorder.prime(sim)
 
     def record(rec, wall_ms=None):
@@ -590,6 +625,20 @@ def main(argv=None) -> int:
             sim.force_log.close()
         if counters is not None:
             counters.uninstall()
+        if metrics_log is not None:
+            # run-report rows: the serving latency distributions and
+            # the compile blame ledger ride the metrics stream so
+            # ``post --metrics`` summarizes them with the records
+            if server is not None and server.latency is not None:
+                metrics_log.emit(event="serving_latency",
+                                 **server.latency.report())
+            if flight is not None:
+                metrics_log.emit(event="compile_ledger",
+                                 **flight.ledger_report())
+        if flight is not None:
+            flight.close()      # flushes the span ring into spans_log
+        if spans_log is not None:
+            spans_log.close()
         if metrics_log is not None:
             metrics_log.close()
         set_event_log(None)

@@ -257,11 +257,16 @@ class AMRSim(ShapeHostMixin):
         self._comm_stats = None
         # jitted ONCE; tables/order/h are arguments, so regrids that
         # reproduce previously-seen shapes hit the XLA compile cache
-        self._step_jit = jax.jit(
-            self._step_impl, static_argnames=("exact_poisson",))
-        self._mega_jit = jax.jit(
-            self._megastep_impl,
-            static_argnames=("exact_poisson", "with_forces"))
+        from . import tracing
+        self._step_jit = tracing.named_jit(
+            "amr.step", jax.jit(
+                self._step_impl, static_argnames=("exact_poisson",)),
+            variant=("exact_poisson",))
+        self._mega_jit = tracing.named_jit(
+            "amr.megastep", jax.jit(
+                self._megastep_impl,
+                static_argnames=("exact_poisson", "with_forces")),
+            variant=("exact_poisson",))
         self._next_dt = None
         self._next_dt_version = -1
         self._dt_jit = None
@@ -294,14 +299,18 @@ class AMRSim(ShapeHostMixin):
         # the same step as the eager path. The shaped branch ignores
         # the flag (its uvw/CoM pull feeds the host kinematics).
         self.async_diag = False
-        self._raster_jit = jax.jit(self._rasterize_impl)
-        self._vorticity_jit = jax.jit(self._vorticity_impl)
-        self._tags_jit = jax.jit(self._tags_impl)
+        self._raster_jit = tracing.named_jit(
+            "amr.rasterize", jax.jit(self._rasterize_impl))
+        self._vorticity_jit = tracing.named_jit(
+            "amr.vorticity", jax.jit(self._vorticity_impl))
+        self._tags_jit = tracing.named_jit(
+            "amr.tags", jax.jit(self._tags_impl))
         # fields are dead after _apply_regrid replaces them — donate so
         # XLA aliases the buffers instead of holding old + new field
         # sets live at once during the fused regrid dispatch
-        self._regrid_jit = jax.jit(
-            self._regrid_apply_impl, donate_argnums=0)
+        self._regrid_jit = tracing.named_jit(
+            "amr.regrid", jax.jit(
+                self._regrid_apply_impl, donate_argnums=0))
 
     def reserve_blocks(self, n: int):
         """Pre-size the padded block axis so every jitted executable
@@ -1770,9 +1779,11 @@ class AMRSim(ShapeHostMixin):
                 isinstance(hmin, jax.core.Tracer):
             return dt_from_umax(umax, hmin, self.cfg.nu, self.cfg.cfl)
         if self._dt_jit is None:
-            self._dt_jit = jax.jit(
-                lambda u, h: dt_from_umax(u, h, self.cfg.nu,
-                                          self.cfg.cfl))
+            from . import tracing
+            self._dt_jit = tracing.named_jit(
+                "amr.dt", jax.jit(
+                    lambda u, h: dt_from_umax(u, h, self.cfg.nu,
+                                              self.cfg.cfl)))
         return self._dt_jit(jnp.asarray(umax, self.forest.dtype), hmin)
 
     def _hmin(self):
@@ -1791,8 +1802,10 @@ class AMRSim(ShapeHostMixin):
         # would discard the pending poisson-iters scalar and disarm
         # the two-level trigger exactly on such drivers (code-review r4)
         if self._umax_jit is None:
-            self._umax_jit = jax.jit(
-                lambda v, m: jnp.max(jnp.abs(v) * m))
+            from . import tracing
+            self._umax_jit = tracing.named_jit(
+                "amr.umax", jax.jit(
+                    lambda v, m: jnp.max(jnp.abs(v) * m)))
         umax = self._umax_jit(self._ordered_state()["vel"], self._maskv)
         return self._float_pull(self._dt_from_umax(umax, self._hmin()))
 
@@ -2017,7 +2030,9 @@ class AMRSim(ShapeHostMixin):
         # the top-level "tables" bucket, never nested under "adapt" (so
         # profiling.throughput can sum phases without double counting)
         self._refresh()
-        with (self.timers or NULL_TIMERS).phase("adapt"):
+        from . import tracing
+        with (self.timers or NULL_TIMERS).phase("adapt"), \
+                tracing.span("regrid", step=int(self.step_count)):
             return self._adapt_impl()
 
     def _adapt_impl(self):

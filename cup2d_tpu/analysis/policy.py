@@ -66,6 +66,11 @@ ENV_LATCH_SITES = {
     # enable-once process knobs (cache paths, not numerics gates)
     ("cache.py", "enable_compilation_cache"): {"CUP2D_CACHE"},
     ("native/__init__.py", "_load"): {"CUP2D_NATIVE_CACHE"},
+    # flight-recorder span-ring latch (ISSUE 18): read once at
+    # construction; the installed recorder stores spans_on/max_spans,
+    # so a mid-run env mutation can never flip the span instrument of
+    # a live run
+    ("tracing.py", "FlightRecorder.from_env"): {"CUP2D_SPANS"},
 }
 
 
@@ -121,6 +126,13 @@ HOST_SYNC_SITES = {
     # blocking transfer — PR 3)
     "shapes_host.py": {"ShapeHostMixin._sync_shape_scalars",
                        "ShapeHostMixin._record_forces"},
+    # flight recorder (ISSUE 18): both scopes are cold paths by
+    # construction — _memory_analysis re-lowers at compile time only
+    # (a run that compiles nothing never enters it) and flush drains
+    # the span ring at shutdown/ring-full; neither runs per step, and
+    # the zero-overhead runtime pin (equal device_gets, equal
+    # jit_compiles) holds with both armed
+    "tracing.py": {"_memory_analysis", "FlightRecorder.flush"},
 }
 
 

@@ -124,8 +124,9 @@ def _const_strs(node: ast.AST) -> Tuple[str, ...]:
 def _jit_call_of(value: ast.AST,
                  imports: Dict[str, str]) -> Optional[ast.Call]:
     """The jax.jit(...) call inside ``value``, unwrapping one level of
-    functools.partial(jax.jit, ...) — the two spellings the package
-    uses (plain assignment and decorator)."""
+    functools.partial(jax.jit, ...) or tracing.named_jit(label,
+    jax.jit(...)) — the three spellings the package uses (plain
+    assignment, decorator, and the compile-ledger label wrapper)."""
     if not isinstance(value, ast.Call):
         return None
     q = qualified_name(value.func, imports)
@@ -135,6 +136,13 @@ def _jit_call_of(value: ast.AST,
         inner = qualified_name(value.args[0], imports)
         if inner == "jax.jit":
             return value
+    # tracing.named_jit("label", jax.jit(...), ...): the donation/
+    # retrace declarations live on the wrapped jit — keep seeing them
+    func = value.func
+    name = func.attr if isinstance(func, ast.Attribute) else \
+        func.id if isinstance(func, ast.Name) else None
+    if name == "named_jit" and len(value.args) >= 2:
+        return _jit_call_of(value.args[1], imports)
     return None
 
 

@@ -66,6 +66,7 @@ stencil forms), where the member axis rides along replicated.
 from __future__ import annotations
 
 import os
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Optional
@@ -74,6 +75,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import tracing
 from .config import SimConfig
 from .ops.pallas_kernels import fused_advect_heun
 from .ops.stencil import (
@@ -200,44 +202,54 @@ class FleetSim:
             self.state = FlowState(*(jax.device_put(a, s) for a, s
                                      in zip(self.state, shardings)))
             out_shardings = (shardings, None)
-        self._step = jax.jit(
-            self._step_impl, donate_argnums=(0,),
-            static_argnames=("exact_poisson",),
-            **({"out_shardings": out_shardings}
-               if out_shardings is not None else {}))
-        self._dt = jax.jit(self._dt_impl)
+        self._step = tracing.named_jit(
+            "fleet.step", jax.jit(
+                self._step_impl, donate_argnums=(0,),
+                static_argnames=("exact_poisson",),
+                **({"out_shardings": out_shardings}
+                   if out_shardings is not None else {})),
+            variant=("exact_poisson",))
+        self._dt = tracing.named_jit("fleet.dt", jax.jit(self._dt_impl))
         # single-member core for the guard's per-member rewind/replay
         # (the cold path): the SAME pure step the solo driver jits, on
         # one member's slice
-        self._member_step = jax.jit(
-            g.step, donate_argnums=(0,),
-            static_argnames=("exact_poisson", "obstacle_terms"))
-        self._member_dt = jax.jit(g.compute_dt)
+        self._member_step = tracing.named_jit(
+            "fleet.solo_ladder", jax.jit(
+                g.step, donate_argnums=(0,),
+                static_argnames=("exact_poisson", "obstacle_terms")),
+            variant=("exact_poisson",))
+        self._member_dt = tracing.named_jit(
+            "fleet.solo_dt", jax.jit(g.compute_dt))
         # slot-pool gather/scatter (FleetServer admit/retire churn):
         # ONE fused executable each, slot index as a device int32
         # operand (any slot, same executable) and the fleet state
         # DONATED on install — an admit/retire costs one dispatch, not
         # a per-field op chain plus a full-state copy
-        self._extract_member = jax.jit(
-            lambda state, idx: FlowState(*(a[idx] for a in state)))
-        self._install_member = jax.jit(
-            lambda state, idx, st: FlowState(
-                *(a.at[idx].set(v) for a, v in zip(state, st))),
-            donate_argnums=(0,))
-        self._scatter_next_dt = jax.jit(
-            lambda nd, idx, v: nd.at[idx].set(v), donate_argnums=(0,))
+        self._extract_member = tracing.named_jit(
+            "fleet.extract", jax.jit(
+                lambda state, idx: FlowState(*(a[idx] for a in state))))
+        self._install_member = tracing.named_jit(
+            "fleet.install", jax.jit(
+                lambda state, idx, st: FlowState(
+                    *(a.at[idx].set(v) for a, v in zip(state, st))),
+                donate_argnums=(0,)))
+        self._scatter_next_dt = tracing.named_jit(
+            "fleet.scatter_dt", jax.jit(
+                lambda nd, idx, v: nd.at[idx].set(v),
+                donate_argnums=(0,)))
         # the one-dispatch admit: state install + chained-dt scatter
         # fused, dtv <= 0 meaning "compute the fresh CFL dt from the
         # admitted velocity right here" (bit-identical to
         # grid.compute_dt: the max reduce is order-invariant and
         # dt_from_umax elementwise)
-        self._admit_impl = jax.jit(
-            lambda state, nd, idx, st, dtv: (
-                FlowState(*(a.at[idx].set(v)
-                            for a, v in zip(state, st))),
-                nd.at[idx].set(jnp.where(dtv > 0, dtv,
-                                         g.compute_dt(st.vel)))),
-            donate_argnums=(0, 1))
+        self._admit_impl = tracing.named_jit(
+            "fleet.admit", jax.jit(
+                lambda state, nd, idx, st, dtv: (
+                    FlowState(*(a.at[idx].set(v)
+                                for a, v in zip(state, st))),
+                    nd.at[idx].set(jnp.where(dtv > 0, dtv,
+                                             g.compute_dt(st.vel)))),
+                donate_argnums=(0, 1)))
         # per-slot device indices, transferred once: admit/retire churn
         # re-uses them so a slot op is one dispatch with zero fresh h2d
         self._idx = [jnp.asarray(m, jnp.int32)
@@ -652,7 +664,8 @@ class FleetServer:
 
     def __init__(self, sim: FleetSim, *, guard=None,
                  session_dir: Optional[str] = None,
-                 event_log=None, clients_dir: Optional[str] = None):
+                 event_log=None, clients_dir: Optional[str] = None,
+                 clients_rotate_mb=None, latency=None):
         self.sim = sim
         self.guard = guard
         if guard is not None:
@@ -661,6 +674,9 @@ class FleetServer:
             guard.on_member_abort = self._on_member_abort
         self.session_dir = session_dir
         self.event_log = event_log
+        # tracing.ServingLatency (or None): queue-wait / admit-to-
+        # first-step / per-step histograms, host clocks only
+        self.latency = latency
         self.queue: deque = deque()
         self.active = np.zeros(sim.members, dtype=bool)
         self.t_end = np.full(sim.members, np.inf)
@@ -672,7 +688,8 @@ class FleetServer:
         self.clients = None
         if clients_dir is not None:
             from .profiling import ClientStreams
-            self.clients = ClientStreams(clients_dir)
+            self.clients = ClientStreams(clients_dir,
+                                         rotate_mb=clients_rotate_mb)
         # one cached zero template: EVICTION re-zeroes the slot through
         # the same one-executable scatter admission uses (an aborted
         # member's NaN state must not leak into the masked step's
@@ -689,6 +706,8 @@ class FleetServer:
     # -- client API ---------------------------------------------------
     def submit(self, req: FleetRequest) -> None:
         """Enqueue a session; it is admitted at the next free slot."""
+        if self.latency is not None:
+            self.latency.on_submit(req.client_id)
         self.queue.append(req)
 
     def client_of(self, m: int):
@@ -729,6 +748,13 @@ class FleetServer:
         return n
 
     def _admit(self, slot: int, req: FleetRequest) -> None:
+        with tracing.span("admit", member=slot,
+                          client=str(req.client_id)):
+            self._admit_inner(slot, req)
+        if self.latency is not None:
+            self.latency.on_admit(req.client_id)
+
+    def _admit_inner(self, slot: int, req: FleetRequest) -> None:
         sim = self.sim
         if req.bc is not None and req.bc != sim.grid.bc:
             # slot-pool executables are BC-table-specific (the edge
@@ -776,18 +802,19 @@ class FleetServer:
 
     def _retire(self, slot: int) -> None:
         cid = self.client[slot]
-        ckpt = None
-        if self.session_dir is not None:
-            from .io import save_member_checkpoint
-            ckpt = os.path.join(self.session_dir, str(cid))
-            save_member_checkpoint(ckpt, self.sim, slot)
-        t_done = float(self.sim.times[slot])
-        self._free_slot(slot)
-        self.retired += 1
-        if self.clients is not None:
-            self.clients.close(cid)
-        self._emit(event="member_retire", member=slot, client=cid,
-                   t=t_done, checkpoint=ckpt)
+        with tracing.span("retire", member=slot, client=str(cid)):
+            ckpt = None
+            if self.session_dir is not None:
+                from .io import save_member_checkpoint
+                ckpt = os.path.join(self.session_dir, str(cid))
+                save_member_checkpoint(ckpt, self.sim, slot)
+            t_done = float(self.sim.times[slot])
+            self._free_slot(slot)
+            self.retired += 1
+            if self.clients is not None:
+                self.clients.close(cid)
+            self._emit(event="member_retire", member=slot, client=cid,
+                       t=t_done, checkpoint=ckpt)
 
     def _on_member_abort(self, m: int, reason: str, step: int) -> None:
         """The guard's eviction hook (per-member ladder exhausted):
@@ -797,16 +824,19 @@ class FleetServer:
         live states — their trajectories and clocks pass through
         bit-unchanged."""
         cid = self.client[m]
-        self._free_slot(m, zero=True)
-        self.evicted += 1
-        # sync NOW, not lazily: the guard is mid-step and its replay
-        # of the surviving members runs against the device mask
-        self.sim.set_active(self.active)
-        self._mask_dirty = False
-        if self.clients is not None:
-            self.clients.close(cid)
-        self._emit(event="member_evict", member=m, client=cid,
-                   reason=reason, step=step)
+        with tracing.span("evict", member=m, client=str(cid),
+                          reason=reason):
+            self._free_slot(m, zero=True)
+            self.evicted += 1
+            # sync NOW, not lazily: the guard is mid-step and its
+            # replay of the surviving members runs against the device
+            # mask
+            self.sim.set_active(self.active)
+            self._mask_dirty = False
+            if self.clients is not None:
+                self.clients.close(cid)
+            self._emit(event="member_evict", member=m, client=cid,
+                       reason=reason, step=step)
 
     # -- the serving loop ---------------------------------------------
     def step(self) -> Optional[dict]:
@@ -825,6 +855,8 @@ class FleetServer:
             # admissions/retirements above flipped
             self.sim.set_active(self.active)
             self._mask_dirty = False
+        lat = self.latency
+        t0 = time.perf_counter() if lat is not None else 0.0
         rec = (self.guard.step() if self.guard is not None
                else self.sim.step_once())
         # who occupied each slot DURING this fused step: the recorder
@@ -833,6 +865,8 @@ class FleetServer:
         # must still reach its client stream (times[] keeps the
         # retiree's final clock until the next cycle's refill)
         self.step_clients = list(self.client)
+        if lat is not None:
+            lat.on_step(self.step_clients, time.perf_counter() - t0)
         done = np.flatnonzero(self.active
                               & (self.sim.times >= self.t_end))
         for m in done:

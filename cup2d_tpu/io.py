@@ -903,7 +903,10 @@ def _mirror_block_sums(x, n_hosts: int):
     import jax
 
     if _mirror_sums_jit is None:
-        _mirror_sums_jit = jax.jit(_block_sums, static_argnums=(1,))
+        from . import tracing
+        _mirror_sums_jit = tracing.named_jit(
+            "io.mirror_sums",
+            jax.jit(_block_sums, static_argnums=(1,)))
     return _mirror_sums_jit(x, n_hosts)
 
 
@@ -921,9 +924,12 @@ def _mirror_block_sums_tree(payload: dict, n_hosts: int) -> dict:
     import jax
 
     if _mirror_sums_tree_jit is None:
-        _mirror_sums_tree_jit = jax.jit(
-            lambda pl, h: {k: _block_sums(v, h) for k, v in pl.items()},
-            static_argnums=(1,))
+        from . import tracing
+        _mirror_sums_tree_jit = tracing.named_jit(
+            "io.mirror_sums_tree", jax.jit(
+                lambda pl, h: {k: _block_sums(v, h)
+                               for k, v in pl.items()},
+                static_argnums=(1,)))
     return _mirror_sums_tree_jit(payload, n_hosts)
 
 
@@ -970,7 +976,8 @@ def _mirror_capture_fn(mesh, n_hosts: int, sig: tuple):
                     for k, v in mirrored.items()}
             return mirrored, sums
 
-        fn = jax.jit(impl)
+        from . import tracing
+        fn = tracing.named_jit("io.mirror_capture", jax.jit(impl))
         _MIRROR_CAPTURE_CACHE[key] = fn
     return fn
 

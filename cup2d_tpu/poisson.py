@@ -410,6 +410,11 @@ def bicgstab(
     solve (tests/test_fleet.py pins this). ``iters``/``residual``/
     ``converged``/``stalled`` come back per-member [B].
     """
+    # trace-time only: tags the enclosing named executable's compile-
+    # ledger entry with this solver component (tracing.py); a no-op
+    # inside an already-compiled launch
+    from . import tracing
+    tracing.note_component("poisson.bicgstab")
     if M is None:
         M = lambda v: v
     dt_ = b.dtype
@@ -697,6 +702,9 @@ def mg_solve(
     tests/test_fleet.py / test_poisson.py), and
     iters/residual/converged/stalled come back per-member [B].
     """
+    # trace-time only — see the bicgstab note
+    from . import tracing
+    tracing.note_component("poisson.mg_solve")
     dt_ = b.dtype
     if member_axis:
         raxes = tuple(range(1, b.ndim))

@@ -13,11 +13,21 @@ own geometry, so resolution can vary freely).
 ``metrics.jsonl`` stream (profiling.MetricsRecorder schema) as one JSON
 line per file — solver iteration stats, dt/wall distributions, energy
 endpoints, divergence peak, recompile/transfer counters, final AMR
-shape. BENCH_*.json embeds the same summary shape (bench.py), so a
-bench result and a production run read as one trajectory.
+shape, serving latency percentiles and the compile blame ledger.
+Truncated/torn rows (a SIGKILL'd run's last line) are counted as
+``truncated_records``, never raised. BENCH_*.json embeds the same
+summary shape (bench.py), so a bench result and a production run read
+as one trajectory.
+
+``--trace`` exports a run's flushed span timeline (``spans.jsonl``
+plus its per-process ``.pN`` siblings and rotated segments, the
+flight-recorder stream — tracing.py) to Chrome/Perfetto
+``trace.json``: one track per process, one per client session. Load at
+https://ui.perfetto.dev or chrome://tracing.
 
 Usage:  python -m cup2d_tpu.post out/vel.0000001234.xdmf2 [...]
         python -m cup2d_tpu.post --metrics out/metrics.jsonl [...]
+        python -m cup2d_tpu.post --trace out/spans.jsonl [...]
 """
 
 from __future__ import annotations
@@ -67,10 +77,12 @@ def metrics_summary(path: str) -> dict:
     per client under ``clients``."""
     import os
 
-    from .profiling import (load_metrics, summarize_client,
-                            summarize_metrics)
+    from .profiling import (load_metrics, load_metrics_report,
+                            summarize_client, summarize_metrics)
 
-    out = summarize_metrics(load_metrics(path))
+    records, torn = load_metrics_report(path)
+    out = summarize_metrics(records)
+    out["truncated_records"] = torn
     out["source"] = path
     cdir = os.path.join(os.path.dirname(os.path.abspath(path)),
                         "clients")
@@ -83,11 +95,44 @@ def metrics_summary(path: str) -> dict:
     return out
 
 
+def trace_export(path: str, out_path: str | None = None) -> str:
+    """Export a flight-recorder span stream to Perfetto trace JSON.
+
+    ``path`` is the process-0 ``spans.jsonl``; per-process siblings
+    (``spans.jsonl.pN`` — EventLog all_writers mode) and rotated
+    segments of each are folded in automatically, so one command
+    renders a whole multi-process run. Returns the written path."""
+    import glob
+    import os
+    import re
+
+    from .profiling import load_metrics
+    from .tracing import spans_to_perfetto
+
+    # exactly the live per-process siblings: rotated segments (.pN.M,
+    # or .M on the base path) are folded in by load_metrics itself
+    sibs = [p for p in sorted(glob.glob(path + ".p[0-9]*"))
+            if re.fullmatch(r"\.p\d+", p[len(path):])]
+    rows = []
+    for p in [path] + sibs:
+        try:
+            rows.extend(load_metrics(p))
+        except FileNotFoundError:
+            continue
+    trace = spans_to_perfetto(rows)
+    out = out_path or os.path.join(
+        os.path.dirname(os.path.abspath(path)) or ".", "trace.json")
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    return out
+
+
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     if not args:
         print("usage: python -m cup2d_tpu.post <dump>[.xdmf2] ... | "
-              "--metrics <metrics.jsonl> ...", file=sys.stderr)
+              "--metrics <metrics.jsonl> ... | "
+              "--trace <spans.jsonl> ...", file=sys.stderr)
         return 2
     if args[0] == "--metrics":
         if not args[1:]:
@@ -96,6 +141,14 @@ def main(argv=None) -> int:
             return 2
         for a in args[1:]:
             print(json.dumps(metrics_summary(a)))
+        return 0
+    if args[0] == "--trace":
+        if not args[1:]:
+            print("usage: python -m cup2d_tpu.post --trace "
+                  "<spans.jsonl> ...", file=sys.stderr)
+            return 2
+        for a in args[1:]:
+            print(trace_export(a))
         return 0
     for a in args:
         print(render(a))

@@ -249,8 +249,15 @@ _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 def _on_compile(event, duration, **kw):
     if event == _COMPILE_EVENT:
+        from . import tracing
+        if tracing.compiles_suppressed():
+            # a flight-recorder memory-ledger re-lower is compiling:
+            # ledger-internal, invisible to HostCounters AND to the
+            # compile ledger itself (equal-compile-count contract)
+            return
         for c in _ACTIVE_COUNTERS:
             c.jit_compiles += 1
+        tracing._note_compile(float(duration))
 
 
 def _install_hooks() -> None:
@@ -345,7 +352,7 @@ class HostCounters:
 # always present; fields that do not apply to a path (AMR shape on a
 # uniform run, comm volume on a single device, counters when disabled)
 # are null — consumers key on names, never on presence.
-METRICS_SCHEMA_VERSION = 9
+METRICS_SCHEMA_VERSION = 10
 METRICS_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     # solver health + timestep state (the step's existing diag pull).
@@ -427,6 +434,14 @@ METRICS_KEYS = (
     # and member_health above is null on serving records.
     "active_members", "occupancy", "admitted", "evicted",
     "queue_depth",
+    # flight recorder (schema v10, tracing.FlightRecorder): cumulative
+    # span count (absolute — the ring drains to its own spans.jsonl,
+    # the record only gauges volume), cumulative attributed compile
+    # wall ms, and the summed memory_analysis footprint of every
+    # ledgered executable (argument+output+temp+generated-code bytes;
+    # null until a capture lands). Null with no recorder attached —
+    # all three are host state, same zero-pull discipline as counters
+    "span_count", "compile_ms_total", "hbm_exec_bytes",
     # merged PhaseTimers wall times (per-step deltas, ms)
     "phase_ms",
 )
@@ -487,12 +502,13 @@ class MetricsRecorder:
 
     def __init__(self, sink=None, counters: Optional[HostCounters] = None,
                  timers: Optional[PhaseTimers] = None, guard=None,
-                 server=None):
+                 server=None, flight=None):
         self.sink = sink
         self.counters = counters
         self.timers = timers
         self.guard = guard          # resilience.StepGuard, opt-in
         self.server = server        # fleet.FleetServer, opt-in (v7)
+        self.flight = flight        # tracing.FlightRecorder, opt-in (v10)
         self._last_time: Optional[float] = None
         self._last_counters = counters.snapshot() if counters else None
         self._last_phase: dict = dict(timers.acc) if timers else {}
@@ -593,6 +609,7 @@ class MetricsRecorder:
             self._emit_client_rows(rec, member_health)
             member_health = None
         rec["member_health"] = member_health
+        rec.update(self._flight_fields())
         rec["phase_ms"] = self._phase_fields()
         if self.sink is not None:
             self.sink.emit(event="metrics", **rec)
@@ -702,6 +719,19 @@ class MetricsRecorder:
                 "restore_source": (str(src) if src is not None
                                    else None)}
 
+    def _flight_fields(self) -> dict:
+        """Flight-recorder gauges (schema v10): host state on the
+        recorder — span volume, attributed compile cost, ledgered
+        executable footprint. Null slots with no recorder attached."""
+        f = self.flight
+        if f is None:
+            return {"span_count": None, "compile_ms_total": None,
+                    "hbm_exec_bytes": None}
+        hbm = f.hbm_exec_bytes()
+        return {"span_count": int(f.span_count),
+                "compile_ms_total": round(f.compile_ms_total, 3),
+                "hbm_exec_bytes": int(hbm) if hbm else None}
+
     def _phase_fields(self) -> Optional[dict]:
         if self.timers is None:
             return None
@@ -720,12 +750,21 @@ class ClientStreams:
     exist only folded inside the aggregate record's ``member_health``.
     A session's telemetry thereby survives slot reuse (the slot index
     is an allocator detail; the client id is the identity) and is
-    readable per client by ``post --metrics``."""
+    readable per client by ``post --metrics``.
 
-    def __init__(self, dirpath: str):
+    ``rotate_mb`` caps each stream file: a stream crossing the cap is
+    renamed to ``<name>.jsonl.N`` (N ascending in rotation order) and
+    reopened fresh — same scheme as ``EventLog``; ``load_metrics``
+    reads the segments back in order. Off (None) by default: rotation
+    exists for long serving runs, not 200-step CI drills."""
+
+    def __init__(self, dirpath: str, rotate_mb: Optional[float] = None):
         self.dir = dirpath
         os.makedirs(dirpath, exist_ok=True)
         self._files: dict = {}
+        self.rotate_bytes = (int(rotate_mb * 2 ** 20)
+                             if rotate_mb else None)
+        self._seq: dict = {}
 
     @staticmethod
     def _fname(cid) -> str:
@@ -745,6 +784,13 @@ class ClientStreams:
             self._files[cid] = f
         f.write(json.dumps(rec, sort_keys=True, default=float) + "\n")
         f.flush()
+        if self.rotate_bytes and f.tell() >= self.rotate_bytes:
+            path = self.path_of(cid)
+            f.close()
+            seq = self._seq.get(cid, _next_segment_seq(path))
+            os.replace(path, f"{path}.{seq}")
+            self._seq[cid] = seq + 1
+            self._files[cid] = open(path, "a")
 
     def close(self, cid=None) -> None:
         """Close one client's stream (retire/evict) or all of them."""
@@ -789,16 +835,60 @@ def summarize_client(records: list) -> dict:
     }
 
 
+def _next_segment_seq(path: str) -> int:
+    """1 + the highest existing numeric rotation suffix of ``path``."""
+    import glob
+    top = 0
+    for p in glob.glob(path + ".*"):
+        suf = p[len(path) + 1:]
+        if suf.isdigit():
+            top = max(top, int(suf))
+    return top + 1
+
+
+def _segment_paths(path: str) -> list:
+    """Rotated segments of ``path`` in write order (``path.1`` oldest),
+    then the live file itself."""
+    import glob
+    segs = []
+    for p in glob.glob(path + ".*"):
+        suf = p[len(path) + 1:]
+        if suf.isdigit():
+            segs.append((int(suf), p))
+    return [p for _, p in sorted(segs)] + [path]
+
+
 def load_metrics(path: str) -> list:
-    """All JSONL records from ``path`` (mixed event streams are fine;
-    `summarize_metrics` filters for ``event == "metrics"``)."""
-    out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                out.append(json.loads(line))
-    return out
+    """All JSONL records from ``path`` and its rotated segments, in
+    write order (mixed event streams are fine; `summarize_metrics`
+    filters for ``event == "metrics"``). Torn lines are skipped — use
+    :func:`load_metrics_report` to count them."""
+    return load_metrics_report(path)[0]
+
+
+def load_metrics_report(path: str) -> tuple:
+    """(records, truncated_records) from ``path`` plus rotated
+    segments. A SIGKILL'd run leaves a torn last line (and an empty
+    file is a run killed before its first record) — both are facts
+    about the run, not read errors, so unparseable lines are counted
+    and reported instead of raised. A missing path still raises
+    ``FileNotFoundError`` unless rotated segments exist for it."""
+    out: list = []
+    torn = 0
+    paths = [p for p in _segment_paths(path) if os.path.exists(p)]
+    if not paths:
+        open(path).close()     # surface the original FileNotFoundError
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    torn += 1
+    return out, torn
 
 
 def summarize_metrics(records: list) -> dict:
@@ -886,5 +976,22 @@ def summarize_metrics(records: list) -> dict:
         "evicted_total": (col("evicted")[-1]
                           if col("evicted") else None),
         "queue_depth": stats(col("queue_depth")),
+        # flight recorder (schema v10): cumulative span count, compile
+        # blame total and the memory-ledger footprint are gauges — the
+        # last value is the run total
+        "span_count": (col("span_count")[-1]
+                       if col("span_count") else None),
+        "compile_ms_total": (col("compile_ms_total")[-1]
+                             if col("compile_ms_total") else None),
+        "hbm_exec_bytes": (col("hbm_exec_bytes")[-1]
+                           if col("hbm_exec_bytes") else None),
     }
+    # run-report event rows (emitted once at exit by the CLI): the
+    # serving-latency distributions and the compile blame ledger ride
+    # the same stream; surface the last of each verbatim
+    for ev in ("serving_latency", "compile_ledger"):
+        rows = [r for r in records if r.get("event") == ev]
+        if rows:
+            out[ev] = {k: v for k, v in rows[-1].items()
+                       if k != "event"}
     return out

@@ -127,6 +127,8 @@ from typing import NamedTuple, Optional
 
 import numpy as np
 
+from . import tracing
+
 
 # ---------------------------------------------------------------------------
 # version-safe distributed-runtime probe (no backend touch, no private API)
@@ -178,21 +180,44 @@ class EventLog:
     lines to one shared-FS file would only duplicate and interleave
     them. Events BEFORE the runtime joins (coordinator connect
     retries) are written by every process: they are genuinely
-    per-process and the world membership is unknown at that point."""
+    per-process and the world membership is unknown at that point.
+    ``all_writers=True`` (the span-timeline sink) opts OUT of the
+    process-0 gate: spans are genuinely per-process, so every process
+    writes — to its own ``<path>.p<idx>`` file past process 0, never
+    interleaving on a shared FS (the Perfetto export merges them).
 
-    def __init__(self, path: str):
+    ``rotate_mb`` caps the file (``-logRotateMB``, default off): on
+    crossing the cap the live file is renamed to the next numbered
+    segment ``<path>.N`` and reopened fresh; ``profiling.load_metrics``
+    reads the segments back in write order. Off by default — rotation
+    exists for long serving runs, and a rotated-away segment is no
+    longer fsync-reachable for the durable-event tail guarantee."""
+
+    def __init__(self, path: str, rotate_mb=None, all_writers=False):
+        self._all_writers = bool(all_writers)
+        if self._all_writers:
+            try:
+                import jax
+                if dist_initialized() and jax.process_index() > 0:
+                    path = f"{path}.p{jax.process_index()}"
+            except Exception:
+                pass
         self.path = path
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        self.rotate_bytes = (int(rotate_mb * 2 ** 20) if rotate_mb
+                             else None)
+        self._seq = None
         self._f = open(path, "a")
 
-    @staticmethod
-    def _is_writer() -> bool:
+    def _is_writer(self) -> bool:
         # version-safe no-probe check (dist_initialized above): must
         # not touch the XLA backend — EventLog exists before
         # init_distributed runs, and a backend probe would make a
         # later initialize() impossible
+        if self._all_writers:
+            return True
         import jax
         return (not dist_initialized()) or jax.process_index() == 0
 
@@ -219,6 +244,17 @@ class EventLog:
                 os.fsync(self._f.fileno())
             except OSError:
                 pass    # non-seekable sink (pipe/pty): flush is all it has
+        if self.rotate_bytes and self._f.tell() >= self.rotate_bytes:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        self._f.close()
+        if self._seq is None:
+            from .profiling import _next_segment_seq
+            self._seq = _next_segment_seq(self.path)
+        os.replace(self.path, f"{self.path}.{self._seq}")
+        self._seq += 1
+        self._f = open(self.path, "a")
 
     def close(self) -> None:
         if not self._f.closed:
@@ -604,25 +640,29 @@ class StepGuard:
     # -- snapshot machinery (device-resident, io.py) ------------------
     def _snapshot(self):
         from .io import snapshot_state_device, mirror_snapshot
-        snap = snapshot_state_device(self.sim)
-        mh = self.mirror_hosts
-        mesh = getattr(self.sim, "mesh", None)
-        if mh is not None and mesh is not None:
-            self._mirror_tick += 1
-            if self._mirror_tick >= self.mirror_every:
-                t0 = time.perf_counter()
-                m = mirror_snapshot(snap, mesh, mh)
-                if m is None:
-                    # unmirrorable family (forest payloads, odd
-                    # divisibility): latch the tier off rather than
-                    # re-probing every capture
-                    self.mirror_hosts = None
-                else:
-                    snap = snap._replace(mirror=m)
-                    self._mirror_tick = 0
-                # enqueue-side only — the collective itself overlaps
-                # with the next dispatch (async device execution)
-                self.mirror_ms_total += (time.perf_counter() - t0) * 1e3
+        with tracing.span("snapshot", step=int(self.sim.step_count)):
+            snap = snapshot_state_device(self.sim)
+            mh = self.mirror_hosts
+            mesh = getattr(self.sim, "mesh", None)
+            if mh is not None and mesh is not None:
+                self._mirror_tick += 1
+                if self._mirror_tick >= self.mirror_every:
+                    t0 = time.perf_counter()
+                    with tracing.span("mirror",
+                                      step=int(self.sim.step_count)):
+                        m = mirror_snapshot(snap, mesh, mh)
+                    if m is None:
+                        # unmirrorable family (forest payloads, odd
+                        # divisibility): latch the tier off rather than
+                        # re-probing every capture
+                        self.mirror_hosts = None
+                    else:
+                        snap = snap._replace(mirror=m)
+                        self._mirror_tick = 0
+                    # enqueue-side only — the collective itself overlaps
+                    # with the next dispatch (async device execution)
+                    self.mirror_ms_total += \
+                        (time.perf_counter() - t0) * 1e3
         return snap
 
     def ring_nbytes(self) -> int:
@@ -664,6 +704,10 @@ class StepGuard:
         """Dispatch one step; return the most recently VERDICTED step's
         record (host scalars + ``step``/``t``/``dt``), or None when the
         first lagged dispatch is still in flight."""
+        with tracing.span("step", step=int(self.sim.step_count)):
+            return self._step_guarded(dt)
+
+    def _step_guarded(self, dt: Optional[float]) -> Optional[dict]:
         self._seed()
         out = None
         # Two-level-trigger freshness window (PR 6): while the trigger
@@ -760,6 +804,16 @@ class StepGuard:
     def _dispatch(self, dt) -> None:
         sim = self.sim
         step0, t0 = sim.step_count, sim.time
+        if tracing.recorder() is not None:
+            # compile-ledger context (host strings, recorder-on only):
+            # the trigger step and the dispatch-time latch token any
+            # compile fired by this dispatch gets blamed on
+            tracing.note_step(step0)
+            mode = getattr(sim, "poisson_mode", None)
+            tier = getattr(sim, "kernel_tier", None)
+            if mode is not None or tier is not None:
+                tracing.note_token("/".join(
+                    str(x) for x in (mode, tier) if x is not None))
         trig = self._trigger_state()
         diag = self._attempt(dt, exact=False)
         pend = _Pending(
@@ -793,9 +847,12 @@ class StepGuard:
 
     def _resolve_oldest(self) -> dict:
         pend = self._pendings.pop(0)
-        # the ONE batched pull (host-side already on the eager paths)
-        vals = _host_scalars(pend.diag, _PULL_KEYS)
-        v = self._verdict_from(vals, pend.step0)
+        with tracing.span("verdict", step=int(pend.step0)):
+            # the ONE batched pull (host-side already on the eager
+            # paths) — where the diag is on device this span fences,
+            # so its interval is fence-accurate by construction
+            vals = _host_scalars(pend.diag, _PULL_KEYS)
+            v = self._verdict_from(vals, pend.step0)
         if v.ok:
             return self._commit(pend, vals)
         return self._recover(pend, vals, v)
@@ -898,61 +955,70 @@ class StepGuard:
         dt_used = self._dt_of(pend, vals)
         rung = 0
         retry_dt: Optional[float] = None
-        while True:
-            action = self._next_action(rung)
-            if action == "abort":
-                self._abort(step0, v, vals, dt_used)
-            replayed = 0
-            if action in ("retry", "escalate"):
-                replayed = self._rewind_replay()
-                if pend.trig is not None:
-                    # the retry consults the trigger with the same
-                    # inputs the failed step's dispatch saw
-                    self.sim._coarse_on, self.sim._last_iters = pend.trig
-                    self.sim._last_iters_dev = None
-                if action == "retry":
-                    # half the failed dt; a nonfinite dt (fault at a
-                    # cold-cache step) falls back to a fresh CFL dt
-                    # from the restored clean state
-                    retry_dt = (0.5 * dt_used
-                                if np.isfinite(dt_used) and dt_used > 0
-                                else None)
-            else:  # disk_restore: rewind possibly many steps
-                from .io import load_checkpoint
-                load_checkpoint(self.ckpt_dir, sim)
-                self.ring.clear()
-                self._reanchor()
-                if self.watchdog is not None:
-                    # the window now describes steps FORWARD of the
-                    # restored point — stale as a baseline
-                    self.watchdog.reset()
-                retry_dt = None
-            self._emit(step=step0, verdict=v.reason, action=action,
-                       dt=dt_used, rung=rung, replayed=replayed)
-            self.recoveries += 1
-            # the retry itself verdicts SYNCHRONOUSLY — recovery is the
-            # cold path, the lag exists for the steady state
-            t0, s0 = sim.time, sim.step_count
-            exact_retry = action == "escalate"
-            trig = self._trigger_state()
-            diag = self._attempt(retry_dt, exact=exact_retry)
-            advanced = sim.time != t0
-            vals = _host_scalars(diag, _PULL_KEYS)
-            v2 = self._verdict_from(vals, s0)
-            p2 = _Pending(
-                step0=s0, t0=t0, diag=diag,
-                exact=bool(s0 < 10 or exact_retry),
-                dt_host=(sim.time - t0 if advanced else None),
-                advanced=advanced, trig=trig)
-            if v2.ok:
-                # recovered: take a FRESH anchor unconditionally (the
-                # replay list must restart from a clean base)
-                p2.snap = self._snapshot()
-                self._since_snap = 0
-                return self._commit(p2, vals)
-            v = v2
-            dt_used = self._dt_of(p2, vals)
-            rung += 1
+        with tracing.span("recover", step=int(step0), verdict=v.reason):
+            while True:
+                action = self._next_action(rung)
+                # one span per ladder rung, named by its action — an
+                # aborting rung keeps its interval (error-marked), so
+                # the timeline shows where the ladder died
+                with tracing.span(action, step=int(step0), rung=rung):
+                    if action == "abort":
+                        self._abort(step0, v, vals, dt_used)
+                    replayed = 0
+                    if action in ("retry", "escalate"):
+                        replayed = self._rewind_replay()
+                        if pend.trig is not None:
+                            # the retry consults the trigger with the
+                            # same inputs the failed step's dispatch saw
+                            self.sim._coarse_on, self.sim._last_iters \
+                                = pend.trig
+                            self.sim._last_iters_dev = None
+                        if action == "retry":
+                            # half the failed dt; a nonfinite dt (fault
+                            # at a cold-cache step) falls back to a
+                            # fresh CFL dt from the restored clean state
+                            retry_dt = (0.5 * dt_used
+                                        if np.isfinite(dt_used)
+                                        and dt_used > 0 else None)
+                    else:  # disk_restore: rewind possibly many steps
+                        from .io import load_checkpoint
+                        load_checkpoint(self.ckpt_dir, sim)
+                        self.ring.clear()
+                        self._reanchor()
+                        if self.watchdog is not None:
+                            # the window now describes steps FORWARD of
+                            # the restored point — stale as a baseline
+                            self.watchdog.reset()
+                        retry_dt = None
+                    self._emit(step=step0, verdict=v.reason,
+                               action=action, dt=dt_used, rung=rung,
+                               replayed=replayed)
+                    self.recoveries += 1
+                    # the retry itself verdicts SYNCHRONOUSLY —
+                    # recovery is the cold path, the lag exists for
+                    # the steady state
+                    t0, s0 = sim.time, sim.step_count
+                    exact_retry = action == "escalate"
+                    trig = self._trigger_state()
+                    diag = self._attempt(retry_dt, exact=exact_retry)
+                    advanced = sim.time != t0
+                    vals = _host_scalars(diag, _PULL_KEYS)
+                    v2 = self._verdict_from(vals, s0)
+                    p2 = _Pending(
+                        step0=s0, t0=t0, diag=diag,
+                        exact=bool(s0 < 10 or exact_retry),
+                        dt_host=(sim.time - t0 if advanced else None),
+                        advanced=advanced, trig=trig)
+                    if v2.ok:
+                        # recovered: take a FRESH anchor
+                        # unconditionally (the replay list must
+                        # restart from a clean base)
+                        p2.snap = self._snapshot()
+                        self._since_snap = 0
+                        return self._commit(p2, vals)
+                    v = v2
+                    dt_used = self._dt_of(p2, vals)
+                    rung += 1
 
     def _rewind_replay(self) -> int:
         """Restore the latest anchor, then replay the recorded good
@@ -1016,11 +1082,15 @@ class StepGuard:
                             if self.faults is not None else ())
         if exact:
             sim._force_exact = True
-        try:
-            return sim.step_once(dt=dt)
-        finally:
-            if exact:
-                sim._force_exact = False
+        # enqueue-side span: on the async paths the dispatch returns
+        # with the diag still in flight — this times the enqueue, the
+        # verdict span times the fence (the pipeline it must not stall)
+        with tracing.span("dispatch", step=int(sim.step_count)):
+            try:
+                return sim.step_once(dt=dt)
+            finally:
+                if exact:
+                    sim._force_exact = False
 
     def _next_action(self, rung: int) -> str:
         if not self.recover:
@@ -1110,6 +1180,11 @@ class StepGuard:
         (disabled when fewer than two hosts remain — no neighbor left
         to hold a mirror).
         """
+        with tracing.span("remesh", step=int(self.sim.step_count),
+                          epoch=int(topo.epoch)):
+            return self._elastic_recover(topo)
+
+    def _elastic_recover(self, topo: "TopologyGuard") -> None:
         import time as _time
 
         sim = self.sim
@@ -1314,9 +1389,10 @@ class FleetStepGuard(StepGuard):
     # -- vectorized verdict -------------------------------------------
     def _resolve_oldest(self) -> dict:
         pend = self._pendings.pop(0)
-        vals = _host_scalars(pend.diag, _PULL_KEYS)   # [B] vectors
-        verdicts = self._member_verdicts(vals, pend.step0)
-        bad = [m for m, v in enumerate(verdicts) if not v.ok]
+        with tracing.span("verdict", step=int(pend.step0)):
+            vals = _host_scalars(pend.diag, _PULL_KEYS)   # [B] vectors
+            verdicts = self._member_verdicts(vals, pend.step0)
+            bad = [m for m, v in enumerate(verdicts) if not v.ok]
         if not bad:
             return self._commit(pend, vals)
         return self._recover_members(pend, vals, verdicts, bad)
@@ -1435,46 +1511,57 @@ class FleetStepGuard(StepGuard):
         sim = self.sim
         dt_used = float(np.asarray(vals["dt"])[m])
         rung = 0
-        while True:
-            if not self.recover or rung >= 2:
-                self._abort_member(m, step0, v, vals, dt_used)
-                # eviction (serving mode): the slot is free, the fleet
-                # lives on — patch the record with an inert lane so the
-                # fold aggregates don't carry the dead member's NaNs
-                return {"dt": 0.0, "dt_next": 1.0, "finite": True,
-                        "umax": 0.0, "energy": 0.0, "div_linf": 0.0,
-                        "poisson_iters": 0, "poisson_residual": 0.0,
-                        "poisson_stalled": False,
-                        "poisson_converged": True, "precond_cycles": 0}
-            replayed = self._rewind_member(m, anchor)
-            exact = rung == 1
-            retry_dt = (0.5 * dt_used
-                        if rung == 0 and np.isfinite(dt_used)
-                        and dt_used > 0 else None)
-            self._emit(step=step0, member=m, verdict=v.reason,
-                       action=("retry" if rung == 0 else "escalate"),
-                       dt=dt_used, rung=rung, replayed=replayed)
-            self.recoveries += 1
-            # the retry is a FRESH attempt of step0: armed *K faults
-            # re-fire (looked up by the step being retried — the
-            # SHARED fleet counter already advanced past it)
-            self._last_fired = (
-                self.faults.apply_pre_step(sim, step=step0)
-                if self.faults is not None else ())
-            diag = sim.member_step_once(
-                m, dt=retry_dt, exact=(exact or step0 < 10))
-            mv = _host_scalars(diag, _PULL_KEYS)
-            v2 = self._one_member_verdict(m, mv, step0)
-            if v2.ok:
-                sim.times[m] += float(mv["dt"])
-                sim.time = float(sim.times.min())
-                sim.set_member_next_dt(m, mv["dt_next"])
-                if self.member_watchdogs is not None:
-                    self.member_watchdogs[m].observe(mv)
-                return mv
-            v = v2
-            dt_used = float(mv["dt"])
-            rung += 1
+        with tracing.span("recover", step=int(step0), member=m,
+                          verdict=v.reason):
+            while True:
+                if not self.recover or rung >= 2:
+                    # serving mode nests the server's client-attributed
+                    # "evict" span here (the on_member_abort callback)
+                    self._abort_member(m, step0, v, vals, dt_used)
+                    # eviction (serving mode): the slot is free, the
+                    # fleet lives on — patch the record with an inert
+                    # lane so the fold aggregates don't carry the dead
+                    # member's NaNs
+                    return {"dt": 0.0, "dt_next": 1.0, "finite": True,
+                            "umax": 0.0, "energy": 0.0,
+                            "div_linf": 0.0, "poisson_iters": 0,
+                            "poisson_residual": 0.0,
+                            "poisson_stalled": False,
+                            "poisson_converged": True,
+                            "precond_cycles": 0}
+                action = "retry" if rung == 0 else "escalate"
+                with tracing.span(action, step=int(step0), member=m,
+                                  rung=rung):
+                    replayed = self._rewind_member(m, anchor)
+                    exact = rung == 1
+                    retry_dt = (0.5 * dt_used
+                                if rung == 0 and np.isfinite(dt_used)
+                                and dt_used > 0 else None)
+                    self._emit(step=step0, member=m, verdict=v.reason,
+                               action=action, dt=dt_used, rung=rung,
+                               replayed=replayed)
+                    self.recoveries += 1
+                    # the retry is a FRESH attempt of step0: armed *K
+                    # faults re-fire (looked up by the step being
+                    # retried — the SHARED fleet counter already
+                    # advanced past it)
+                    self._last_fired = (
+                        self.faults.apply_pre_step(sim, step=step0)
+                        if self.faults is not None else ())
+                    diag = sim.member_step_once(
+                        m, dt=retry_dt, exact=(exact or step0 < 10))
+                    mv = _host_scalars(diag, _PULL_KEYS)
+                    v2 = self._one_member_verdict(m, mv, step0)
+                    if v2.ok:
+                        sim.times[m] += float(mv["dt"])
+                        sim.time = float(sim.times.min())
+                        sim.set_member_next_dt(m, mv["dt_next"])
+                        if self.member_watchdogs is not None:
+                            self.member_watchdogs[m].observe(mv)
+                        return mv
+                    v = v2
+                    dt_used = float(mv["dt"])
+                    rung += 1
 
     def _rewind_member(self, m: int, anchor) -> int:
         """Restore member ``m``'s slice from the anchor snapshot, then

@@ -101,18 +101,25 @@ class Simulation(ShapeHostMixin):
         for s in self.shapes:
             w = int(np.ceil(1.25 * s.length / g.h)) + 12
             self._wins.append((min(w, g.nx), min(w, g.ny)))
-        self._rasterize = jax.jit(self._rasterize_impl)
+        from . import tracing
+        self._rasterize = tracing.named_jit(
+            "sim.rasterize", jax.jit(self._rasterize_impl))
         # donate the state (arg 0) so pass-through fields aren't copied
         # every step; obs is NOT donated — _log_forces reads it after
         # the flow step returns
-        self._flow_step = jax.jit(
-            self._flow_step_impl, donate_argnums=(0,),
-            static_argnames=("exact_poisson",))
-        self._flow_step_empty = jax.jit(
-            g.step, donate_argnums=(0,),
-            static_argnames=("exact_poisson", "obstacle_terms"))
-        self._forces = jax.jit(self._forces_impl)
-        self._dt = jax.jit(g.compute_dt)
+        self._flow_step = tracing.named_jit(
+            "sim.flow_step", jax.jit(
+                self._flow_step_impl, donate_argnums=(0,),
+                static_argnames=("exact_poisson",)),
+            variant=("exact_poisson",))
+        self._flow_step_empty = tracing.named_jit(
+            "sim.flow_step_empty", jax.jit(
+                g.step, donate_argnums=(0,),
+                static_argnames=("exact_poisson", "obstacle_terms")),
+            variant=("exact_poisson",))
+        self._forces = tracing.named_jit(
+            "sim.forces", jax.jit(self._forces_impl))
+        self._dt = tracing.named_jit("sim.dt", jax.jit(g.compute_dt))
         self.compute_forces_every = 1   # 0 disables the diagnostics pass
         self.force_log: Optional[object] = None  # file-like, CSV rows
         self.timers = None              # profiling.PhaseTimers, opt-in

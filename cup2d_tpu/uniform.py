@@ -589,10 +589,14 @@ class UniformSim:
         # return value; the donated input buffers are invalidated.
         # UniformSim is the obstacle-free driver, so the obstacle terms
         # are statically dropped.
-        self._step = jax.jit(
-            self.grid.step, donate_argnums=(0,),
-            static_argnames=("exact_poisson", "obstacle_terms"))
-        self._dt = jax.jit(self.grid.compute_dt)
+        from . import tracing
+        self._step = tracing.named_jit(
+            "uniform.step", jax.jit(
+                self.grid.step, donate_argnums=(0,),
+                static_argnames=("exact_poisson", "obstacle_terms")),
+            variant=("exact_poisson",))
+        self._dt = tracing.named_jit(
+            "uniform.dt", jax.jit(self.grid.compute_dt))
 
     @property
     def poisson_mode(self) -> str:

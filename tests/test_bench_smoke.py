@@ -84,6 +84,14 @@ def test_bench_cpu_smoke():
     assert 0 < srv["occupancy_mean"] <= 1, srv
     assert srv["admitted"] > srv["retired"] >= 4, srv
     assert srv["evicted"] == 0, srv
+    # serving latency histograms (PR 18): the pool-wide block must be
+    # present with all three distributions populated by the churn —
+    # every fused step observed, percentiles ordered and positive
+    slat = srv["serving_latency"]
+    for kind in ("queue_wait", "admit_to_first_step", "step"):
+        assert slat[kind]["count"] > 0, slat
+    assert slat["step"]["p50_ms"] > 0, slat
+    assert slat["step"]["p99_ms"] >= slat["step"]["p50_ms"], slat
     # mirror-overhead point (PR 17): the host-redundant snapshot tier
     # measured on the bench's 2 forced virtual devices grouped into 2
     # hosts — present, no error, sane values (non-negative overhead,

@@ -73,8 +73,11 @@ def _amr_sim():
 # and case, the case-registry tag or null for ad-hoc runs, bc.py +
 # cases.py); v9 the host-redundant mirror-tier group (mirror_bytes /
 # mirror_ms / restore_source — the neighbor-mirrored snapshot ring and
-# the rung attribution of elastic recoveries, PR 17).
-_SCHEMA_V9_KEYS = (
+# the rung attribution of elastic recoveries, PR 17); v10 the
+# flight-recorder gauges (span_count / compile_ms_total /
+# hbm_exec_bytes — the tracing.FlightRecorder span ring and
+# compile/memory ledger, PR 18).
+_SCHEMA_V10_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     "umax", "dt_next",
     "poisson_iters", "poisson_residual",
@@ -92,19 +95,20 @@ _SCHEMA_V9_KEYS = (
     "fleet_members", "member_steps_per_s", "member_health",
     "active_members", "occupancy", "admitted", "evicted",
     "queue_depth",
+    "span_count", "compile_ms_total", "hbm_exec_bytes",
     "phase_ms",
 )
 
 
-def test_metrics_schema_v9_key_set_pinned():
+def test_metrics_schema_v10_key_set_pinned():
     from cup2d_tpu.profiling import METRICS_SCHEMA_VERSION
-    assert METRICS_SCHEMA_VERSION == 9
-    assert METRICS_KEYS == _SCHEMA_V9_KEYS
+    assert METRICS_SCHEMA_VERSION == 10
+    assert METRICS_KEYS == _SCHEMA_V10_KEYS
 
 
 @pytest.mark.slow   # ~17 s; duplicative tier-1 coverage: the frozen key
 #                     SET is pinned as a literal tuple in
-#                     test_metrics_schema_v9_key_set_pinned and the
+#                     test_metrics_schema_v10_key_set_pinned and the
 #                     uniform producer stream (every record, key-exact)
 #                     in test_cli_metrics_stream_and_post_report; the
 #                     AMR/bench records drilled here ride the identical
