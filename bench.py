@@ -693,7 +693,37 @@ def run_poisson_curve(size: int, tol_rel: float = 1e-3,
     amplified through the undivided Laplacian — measured, f64 cycles
     sail through to any target), while BiCGSTAB's recursive residual
     drifts optimistically below that floor. Comparing at 1e-4 would
-    pit an honest residual against a drifted one."""
+    pit an honest residual against a drifted one.
+
+    Memory-tiered arms (ISSUE 19) + the kernel_curve roofline fields:
+    fas_v+strip runs the same f32 hierarchy with the sweep chains
+    fused to one strip pipeline each; fas_v+bf16leg additionally
+    stores the cycle legs bf16 (mg_solve's outer loop keeps the f32
+    true residual, so all fas arms converge by the SAME Linf
+    criterion). Each arm carries modeled f32-equivalent HBM passes
+    per iteration (1 pass = one size^2 f32 field), the modeled bytes,
+    and the derived HBM-util% / MFU% against the v5e peaks — the
+    kernel_curve r04-anchor methodology.
+
+    Bytes model, per V(2,2) cycle (1 Jacobi sweep = read e + read r +
+    write e = 3 passes, 2 when the first sweep starts from zero; one
+    level visit = pre-chain + residual 3 + restrict 1.25 + prolong
+    2.25 + post-chain; the level ladder sums to 4/3 of the finest;
+    mg_solve's outer true-residual + correction add ~4 f32 passes):
+      fas_v / fas_f   : (5 + 3 + 1.25 + 2.25 + 6) * 4/3 + 4 ~ 27.3
+      fas_v+strip     : chains at 1 read (e, r) + 1 write -> level
+                        (2 + 3 + 1.25 + 2.25 + 3) * 4/3 + 4 ~ 19.3
+      fas_v+bf16leg   : same strip passes at bf16 width on the legs
+                        (x 0.5), f32 outer -> 15.33 * 0.5 + 4 ~ 11.7
+      bicgstab_jacobi : per iter, 2 A (3 each) + 2 block-precond
+                        (2 each) + ~12 Krylov vector passes ~ 22
+      bicgstab_mg     : block-precond -> one bf16 V(2,2) cycle
+                        (23.3 * 0.5 each) + 2 A + vectors ~ 41.3
+    The flops model is equally coarse (laps/cycle x ~7 flops/cell +
+    sweep updates) — the fields track cross-round MOVEMENT, and the
+    pinned acceptance is the fas_v : fas_v+bf16leg byte ratio >= 2 at
+    iters within +1. util percentages are meaningless in
+    interpret_mode (flagged), exactly like run_kernel_curve."""
     from cup2d_tpu.config import SimConfig
     from cup2d_tpu.ops.stencil import divergence_rhs
     from cup2d_tpu.poisson import (MultigridPreconditioner, bicgstab,
@@ -710,17 +740,23 @@ def run_poisson_curve(size: int, tol_rel: float = 1e-3,
                        pad_vector(state0.udef, 1),
                        state0.chi, 1, grid.h, dt)
 
-    # solver-precision cycles for the FAS arms (the CUP2D_POIS=fas
-    # hierarchy, see UniformGrid: a bf16 cycle is fine as a
-    # preconditioner but floors a FULL solver above the 1e-4 target).
-    # Both arms' hierarchies are built EXPLICITLY rather than reusing
-    # grid.mg: that one's cycle dtype follows the CUP2D_POIS latch, so
-    # a bench run under CUP2D_POIS=fas would silently time an
-    # f32-cycle preconditioner in the "production default" arm and
-    # break cross-round curve comparison.
+    # EVERY arm's hierarchy is built EXPLICITLY with its own
+    # cycle_dtype/leg_dtype/smoother rather than reusing grid.mg: that
+    # one's tier follows the CUP2D_POIS/CUP2D_PREC/CUP2D_PALLAS
+    # latches, so a bench run under any env latch would silently time
+    # a mislabeled arm and break cross-round curve comparison (the
+    # PR-6 contamination fix, extended to the ISSUE-19 tiers).
+    from cup2d_tpu.ops.pallas_kernels import _on_accel
     mgp = MultigridPreconditioner(grid.ny, grid.nx, grid.dtype)
     mgf = MultigridPreconditioner(grid.ny, grid.nx, grid.dtype,
                                   cycle_dtype=grid.dtype)
+    mgs = MultigridPreconditioner(grid.ny, grid.nx, grid.dtype,
+                                  cycle_dtype=grid.dtype,
+                                  smoother="strip")
+    mgb = MultigridPreconditioner(grid.ny, grid.nx, grid.dtype,
+                                  cycle_dtype=grid.dtype,
+                                  leg_dtype=jnp.bfloat16,
+                                  smoother="strip")
     solvers = {
         "bicgstab_jacobi": lambda bb: bicgstab(
             grid.laplacian, bb, M=grid.precond, tol=0.0,
@@ -734,7 +770,30 @@ def run_poisson_curve(size: int, tol_rel: float = 1e-3,
         "fas_f": lambda bb: mg_solve(
             grid.laplacian, bb, mgf, tol=0.0,
             tol_rel=tol_rel, max_cycles=200, fmg=True),
+        "fas_v+strip": lambda bb: mg_solve(
+            grid.laplacian, bb, mgs, tol=0.0,
+            tol_rel=tol_rel, max_cycles=200),
+        "fas_v+bf16leg": lambda bb: mg_solve(
+            grid.laplacian, bb, mgb, tol=0.0,
+            tol_rel=tol_rel, max_cycles=200),
     }
+    # modeled f32-equivalent HBM passes and flops per ITERATION (see
+    # docstring; 1 pass = one size^2 f32 field, flops/cell coarse)
+    hbm_model = {
+        "bicgstab_jacobi": (22.0, 24.0),
+        "bicgstab_mg": (41.3, 75.0),
+        "fas_v": (27.3, 60.0),
+        "fas_f": (27.3, 60.0),
+        "fas_v+strip": (19.3, 60.0),
+        "fas_v+bf16leg": (11.7, 60.0),
+    }
+    tier_label = {
+        "fas_v": mgf.smoother_tier, "fas_f": mgf.smoother_tier,
+        "fas_v+strip": mgs.smoother_tier,
+        "fas_v+bf16leg": mgb.smoother_tier,
+    }
+    fb = float(size * size) * 4.0
+    cells = float(size * size)
     lat = None
     paths = {}
     norm0 = float(jnp.max(jnp.abs(b)))
@@ -751,19 +810,38 @@ def run_poisson_curve(size: int, tol_rel: float = 1e-3,
         wall = max((time.perf_counter() - t0 - n_rep * lat) / n_rep,
                    1e-9)
         iters = int(res.iters)
+        ms_iter = wall / max(iters, 1) * 1e3
+        passes, flops_cell = hbm_model[name]
+        sec_iter = ms_iter * 1e-3
         paths[name] = {
             "iters": iters,
             "ms_per_solve": round(wall * 1e3, 3),
-            "ms_per_iter": round(wall / max(iters, 1) * 1e3, 3),
+            "ms_per_iter": round(ms_iter, 3),
             "residual_rel": float(res.residual) / norm0,
             "converged": bool(res.converged),
+            "hbm_passes": passes,
+            "hbm_bytes": passes * fb,
+            "hbm_util_pct": round(
+                passes * fb / sec_iter / (PEAK_HBM_GBPS * 1e9)
+                * 100.0, 3),
+            "mfu_pct": round(
+                flops_cell * cells / sec_iter
+                / (PEAK_F32_TFLOPS * 1e12) * 100.0, 3),
         }
+        if name in tier_label:
+            paths[name]["smoother_tier"] = tier_label[name]
     return {"grid": f"{size}x{size}", "tol_rel": tol_rel,
+            "interpret_mode": not _on_accel(),
+            "anchors_r04": {"mfu_pct": 0.95, "hbm_util_pct": 12.0},
             "paths": paths,
             "forest": run_poisson_forest(n_rep=n_rep),
             "note": ("cold-RHS solves at a fixed relative target; "
                      "iters are platform-independent, ms carries the "
-                     "fence methodology of run_size")}
+                     "fence methodology of run_size; hbm_passes/bytes "
+                     "are MODELED per-iteration f32-equivalent field "
+                     "passes (docstring), util/mfu derived against "
+                     "the v5e peaks and meaningless in "
+                     "interpret_mode")}
 
 
 def run_poisson_forest(n_rep: int = 3):

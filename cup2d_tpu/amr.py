@@ -201,14 +201,44 @@ class AMRSim(ShapeHostMixin):
         # discipline). The forest's fusable unit is lab -> RHS (flux
         # corrections interleave before the Heun update), served by the
         # block-batched ops/pallas_kernels.fused_lab_rhs. f32 only —
-        # Mosaic has no f64, and the bf16 storage tier is a
-        # uniform/fleet contract (CUP2D_PREC is latched by UniformGrid,
-        # the one sanctioned read site).
+        # Mosaic has no f64. The ADVECTION bf16 storage tier stays a
+        # uniform/fleet contract (UniformGrid's CUP2D_PREC read); the
+        # SOLVER-side read below is the forest's one sanctioned
+        # CUP2D_PREC site and touches only the FAS cycle legs.
         self._kernel_tier = "xla"
         if os.environ.get("CUP2D_PALLAS", "") == "1":
             from .ops.pallas_kernels import lab_tier_supported
             if lab_tier_supported(cfg.dtype):
                 self._kernel_tier = "pallas-fused"
+        # Memory-tiered FAS (ISSUE 19): CUP2D_PREC=bf16 composes with
+        # the fas latch as a bf16-storage/f32-accumulate tier on the
+        # ForestFASCycle window-image ladder legs ONLY — mg_solve's
+        # outer loop keeps the f32 true residual, the block composite
+        # smoother and the DCT-II base solve stay at solver precision
+        # (BASELINE: bf16 floors a FULL solver at ~2e-4 rel). Any
+        # other composition refuses loudly: the forest has no bf16
+        # advection tier to fall back to, so an un-routed latch would
+        # silently run f32 under a bf16 label.
+        prec = os.environ.get("CUP2D_PREC", "") or "f32"
+        if prec not in ("f32", "bf16"):
+            raise ValueError(
+                f"CUP2D_PREC={prec!r}: expected f32|bf16")
+        self._fas_leg_dtype = None
+        if prec == "bf16":
+            if self._pois_mode not in ("fas", "fas-f"):
+                raise ValueError(
+                    "CUP2D_PREC=bf16 on the forest selects the "
+                    "bf16-leg FAS tier and requires CUP2D_POIS=fas|"
+                    f"fas-f (got CUP2D_POIS={self._pois_mode!r}): "
+                    "the forest has no bf16 advection tier, so the "
+                    "latch would otherwise relabel an f32 run.")
+            if jnp.dtype(cfg.dtype) != jnp.float32:
+                raise ValueError(
+                    "CUP2D_PREC=bf16 needs f32 solver state (got "
+                    f"{jnp.dtype(cfg.dtype).name}): the bf16 legs "
+                    "accumulate in f32; an f64 outer loop would cast "
+                    "through f32 silently.")
+            self._fas_leg_dtype = jnp.bfloat16
         if shapes is None:
             from .sim import make_shapes
             shapes = make_shapes(cfg)
@@ -904,7 +934,8 @@ class AMRSim(ShapeHostMixin):
                 self._fas_transfers(tcoarse)
             mgc = ForestFASCycle(
                 A, self._fas_block_smoother(A, tpois),
-                paint_fine, base_solve, extract_all, cih2)
+                paint_fine, base_solve, extract_all, cih2,
+                leg_dtype=self._fas_leg_dtype)
             res = mg_solve(
                 A, b, mgc,
                 tol=cfg.poisson_tol, tol_rel=cfg.poisson_tol_rel,
@@ -1143,11 +1174,25 @@ class AMRSim(ShapeHostMixin):
         comm/compute-overlapped block-surface form
         (shard_halo.overlap_block_jacobi_sweeps)."""
         p_inv = self.p_inv
+        # strip tier (ISSUE 19): each sweep's residual-precondition-
+        # update tail (r - lap, the P_inv GEMM and the add) fuses into
+        # one Pallas pass over the block batch; the A-apply stays XLA
+        # (it IS the forest operator — gather tables + flux rows). The
+        # from_zero head is a bare GEMM (lap = 0) and stays XLA too.
+        use_fused = False
+        if self._kernel_tier != "xla":
+            from .ops import pallas_kernels as pk
+            use_fused = pk.block_update_supported(self.forest.dtype)
 
         def smooth(e, r, n, from_zero=False):
             if from_zero and n > 0:
                 e = apply_block_precond_blocks(r, p_inv)
                 n -= 1
+            if use_fused:
+                from .ops.pallas_kernels import fused_block_jacobi_update
+                for _ in range(n):
+                    e = fused_block_jacobi_update(e, r, A(e), p_inv)
+                return e
             for _ in range(n):
                 e = e + apply_block_precond_blocks(r - A(e), p_inv)
             return e
@@ -1199,10 +1244,27 @@ class AMRSim(ShapeHostMixin):
     @property
     def prec_mode(self) -> str:
         """Hot-loop storage precision (telemetry schema v6). The forest
-        has no bf16 storage tier (CUP2D_PREC is a uniform/fleet
-        contract), so this is always the field dtype."""
+        has no bf16 ADVECTION storage tier (that CUP2D_PREC reading is
+        a uniform/fleet contract), so this is always the field dtype;
+        the solver-side bf16-leg tier is carried by smoother_tier."""
         return {"float32": "f32", "float64": "f64"}.get(
             self.forest.dtype.name, self.forest.dtype.name)
+
+    @property
+    def smoother_tier(self) -> str:
+        """Smoother tier of the FAS pressure hierarchy (telemetry
+        schema v11): "xla" | "strip" (fused block-Jacobi update pass) |
+        "+bf16" suffix when the window-image ladder legs store bf16.
+        Non-FAS poisson modes have no cycle legs and report "xla"."""
+        base = "xla"
+        if (self._pois_mode in ("fas", "fas-f")
+                and self._kernel_tier != "xla"):
+            from .ops.pallas_kernels import block_update_supported
+            if block_update_supported(self.forest.dtype):
+                base = "strip"
+        if self._fas_leg_dtype is not None:
+            return base + "+bf16"
+        return base
 
     @property
     def bc_table(self) -> str:

@@ -38,9 +38,12 @@ ENV_LATCH_SITES = {
     # and stay env-read-free (the package walk enforces it).
     # CUP2D_PALLAS (PR 9): the forest's own fused-tier latch — the
     # lab-mode megakernel dispatch in _advect_rk2 reads the stored
-    # self._kernel_tier, never the env
+    # self._kernel_tier, never the env.
+    # CUP2D_PREC (ISSUE 19): the forest's SOLVER-side read — the
+    # bf16-leg FAS tier (stored self._fas_leg_dtype; the cycle and
+    # smoother consume the stored dtype, never the env)
     ("amr.py", "AMRSim.__init__"): {"CUP2D_POIS", "CUP2D_TWOLEVEL",
-                                    "CUP2D_PALLAS"},
+                                    "CUP2D_PALLAS", "CUP2D_PREC"},
     # per-grid constructor latches (stored as self._kernel_tier /
     # self.solver_mode+self.fas_fmg). CUP2D_PREC (PR 9) is the
     # storage-precision contract of the fused tier: ONE read site in
@@ -162,14 +165,23 @@ LEADING_DIM_SCOPES = {
     # not assume a rank (kernel bodies below them see fixed block
     # shapes and are exempt by design). _fused_substage_sharded rides
     # the same flat layout from the shard_map body (ISSUE 16)
+    # fused_jacobi_sweeps / fused_block_jacobi_update (ISSUE 19): the
+    # strip-smoother wrappers flatten any leading shape to the same
+    # [L, ...] layout before dispatch (uniform [ny,nx], fleet
+    # [B,ny,nx], forest-lab [B,bs,bs] callers share one executable)
     "ops/pallas_kernels.py": ("fused_advect_heun", "fused_lab_rhs",
                               "fused_correction", "_per_member",
                               "advect_diffuse_rhs_pallas",
-                              "_fused_substage_sharded"),
+                              "_fused_substage_sharded",
+                              "fused_jacobi_sweeps",
+                              "fused_block_jacobi_update"),
     # the sharded megakernel wrapper (ISSUE 16): flattens any leading
     # shape before entering shard_map, so fleet spatial pools (L=B) and
-    # the solo sharded sim (L=1) share one executable per BC token
-    "parallel/shard_halo.py": ("fused_advect_heun_sharded",),
+    # the solo sharded sim (L=1) share one executable per BC token.
+    # _overlap_jacobi_sweeps_strip (ISSUE 19): the halo strip-smoother
+    # form behind overlap_jacobi_sweeps' tier switch
+    "parallel/shard_halo.py": ("fused_advect_heun_sharded",
+                               "_overlap_jacobi_sweeps_strip"),
 }
 
 

@@ -286,6 +286,12 @@ class FleetSim:
         return self.grid.prec_mode
 
     @property
+    def smoother_tier(self) -> str:
+        """Pressure-hierarchy smoother tier (telemetry schema v11) —
+        the pool shares the grid's preconditioner latch."""
+        return self.grid.smoother_tier
+
+    @property
     def bc_table(self) -> str:
         """Pool-wide per-face BC token string (telemetry schema v8)."""
         return self.grid.bc_table

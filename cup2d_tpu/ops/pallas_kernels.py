@@ -938,3 +938,441 @@ def fused_correction(x, pres_old, vel, mx, mp, pfac, ih2, *,
         ],
         interpret=interpret,
     )(scal, x, pres_old, vel)
+
+
+# ---------------------------------------------------------------------------
+# memory-tiered FAS strip smoothers (ISSUE 19): the whole n-sweep
+# damped-Jacobi chain of one MG smooth as ONE time-skewed strip
+# pipeline — the XLA fori_loop form reads e and r from HBM once per
+# sweep (2n+1 field passes for n sweeps); here sweep k of strip j runs
+# as soon as sweep k-1 has produced strips j-1..j+1, so the chain is
+# one HBM read of (e, r) and one write of the result regardless of n.
+# ---------------------------------------------------------------------------
+
+# sweep-chain depth cap: each extra sweep costs one [4, by, nx]
+# intermediate VMEM ring plus one r-ring slot; 6 keeps the 8192-wide
+# f32 worst case under the 16M scoped-vmem budget. The nu1/nu2/nu_img
+# chains are 1-3 sweeps; the 24-sweep coarsest chain (tiny grids, XLA
+# does fine) falls back on purpose.
+_JACOBI_MAX_SWEEPS = 6
+
+
+def jacobi_strip_supported(ny: int, nx: int, dtype, n: int) -> bool:
+    """Gate for ``fused_jacobi_sweeps`` at one MG level: f32/bf16
+    storage, sublane-aligned strip heights, lane-aligned rows on a
+    compiled TPU, and a bounded sweep depth (see _JACOBI_MAX_SWEEPS).
+    A False here is a silent fall-back to the identical-result XLA
+    sweep chain — an optimization gate, not a capability refusal."""
+    if not HAVE_PALLAS:
+        return False
+    dt = jnp.dtype(dtype)
+    if dt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        return False
+    by = _BY_BF16 if dt == jnp.bfloat16 else _BY_F32
+    if ny < by or ny % by:
+        return False
+    if _on_accel() and nx % 128:
+        return False
+    return 1 <= n <= _JACOBI_MAX_SWEEPS
+
+
+def _jacobi_strips_kernel(by, nstr, ny, nx, nsw, omega, gs, from_zero,
+                          store_dtype, r_ref, *rest):
+    """Time-skewed n-sweep Jacobi chain over row strips of one member.
+
+    Grid (L, nstr + nsw - 1): at step i, sweep level k (k = 1..nsw)
+    computes strip j = i - (k - 1) — level k-1's strip j+1 is produced
+    earlier in the SAME program (the Python level loop emits them in
+    order), so every neighbor a sweep needs is resident by the time it
+    runs. Input rings follow the megakernel's exactly-once DMA
+    discipline; intermediate sweeps live in plain 4-slot VMEM rings
+    (compute writes, no DMA); the final sweep writes the out block,
+    whose index map revisits block j = max(i - nsw + 1, 0) so Pallas
+    flushes it exactly once, after the level-nsw write. All arithmetic
+    is f32 (accumulate tier); strips store at ``store_dtype`` — for
+    bf16 legs that is the one rounding per sweep the XLA bf16 chain
+    also pays, for f32 the chain is term-for-term the XLA expression
+    (the ~1-ulp parity contract, tests/test_strip_smoother.py)."""
+    if from_zero:
+        e_ref = None
+        out_ref = rest[0]
+        sc = rest[1:]
+    else:
+        e_ref, out_ref = rest[0], rest[1]
+        sc = rest[2:]
+        ering, esems = sc[0], sc[1]
+        sc = sc[2:]
+    rslots = nsw + 2
+    rring, rsems = sc[0], sc[1]
+    lvls = sc[2:]                     # nsw-1 intermediate sweep rings
+
+    l = pl.program_id(0)
+    i = pl.program_id(1)
+    f32 = jnp.float32
+
+    def rdma(k):
+        slot = _rem(k, rslots)
+        return pltpu.make_async_copy(
+            r_ref.at[l, pl.ds(k * by, by), :],
+            rring.at[slot], rsems.at[slot])
+
+    # r strip j is first consumed by sweep 1 at step j and last by
+    # sweep nsw at step j + nsw - 1; nsw+2 slots keep the window plus
+    # a one-step prefetch live
+    @pl.when(i == 0)
+    def _():
+        rdma(0).start()
+
+    @pl.when(i + 1 < nstr)
+    def _():
+        rdma(i + 1).start()
+
+    @pl.when(i < nstr)
+    def _():
+        rdma(i).wait()
+
+    if not from_zero:
+        def edma(k):
+            slot = _rem(k, 4)
+            return pltpu.make_async_copy(
+                e_ref.at[l, pl.ds(k * by, by), :],
+                ering.at[slot], esems.at[slot])
+
+        @pl.when(i == 0)
+        def _():
+            edma(0).start()
+            if nstr > 1:
+                edma(1).start()
+
+        @pl.when(i + 2 < nstr)
+        def _():
+            edma(i + 2).start()
+
+        @pl.when(i == 0)
+        def _():
+            edma(0).wait()
+            if nstr > 1:
+                edma(1).wait()
+
+        @pl.when((i > 0) & (i + 1 < nstr))
+        def _():
+            edma(i + 1).wait()
+
+    sx_lo, sx_hi, sy_lo, sy_hi = gs
+    zero = jnp.zeros((), f32)
+
+    def corr_inv(j):
+        """The level's signed wall-diagonal row, from GLOBAL indices —
+        the exact values (and groupings) of stencil._edge_ones /
+        MultigridPreconditioner._inv_diag (2-D iota: Mosaic has no
+        1-D iota)."""
+        col = jax.lax.broadcasted_iota(jnp.int32, (by, nx), 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (by, nx), 0) + j * by
+        ex = jnp.where(col == 0, jnp.asarray(sx_lo, f32),
+                       jnp.where(col == nx - 1, jnp.asarray(sx_hi, f32),
+                                 zero))
+        ey = jnp.where(row == 0, jnp.asarray(sy_lo, f32),
+                       jnp.where(row == ny - 1, jnp.asarray(sy_hi, f32),
+                                 zero))
+        corr = (ey + ex) - 4.0
+        return corr, 1.0 / corr
+
+    def sweep(cur, top, bot, rv, corr, inv_d):
+        """One damped-Jacobi update of one strip: zero-ghost 5-point
+        Laplacian (term order of stencil.laplacian5_neumann/_bc) then
+        the _smooth fori-body grouping e + omega*(r - lap)*inv_d."""
+        ecol = jnp.concatenate([top, cur, bot], axis=0)   # [by+2, nx]
+        z = jnp.zeros((by + 2, 1), f32)
+        ew = jnp.concatenate([z, ecol, z], axis=1)        # [by+2, nx+2]
+        lap = (ew[1:-1, 2:] + ew[1:-1, :-2] + ew[2:, 1:-1]
+               + ew[:-2, 1:-1]) + cur * corr
+        return cur + omega * (rv - lap) * inv_d
+
+    for k in range(1, nsw + 1):
+        j = i - (k - 1)
+
+        @pl.when((j >= 0) & (j < nstr))
+        def _(k=k, j=j):
+            rv = rring[_rem(j, rslots)].astype(f32)
+            corr, inv_d = corr_inv(j)
+            if k == 1 and from_zero:
+                # first sweep from e=0: e = omega r / d (the _smooth
+                # from_zero shortcut, same grouping)
+                new = omega * rv * inv_d
+            else:
+                if k == 1:
+                    ring = ering
+                else:
+                    ring = lvls[k - 2]
+
+                def src(m, rows):
+                    # untaken wall branches may read an uninitialized
+                    # ring slot — jnp.where only selects, never
+                    # computes on the discarded operand
+                    return ring[_rem(m, 4)][rows, :].astype(f32)
+
+                cur = src(j, slice(None))
+                top = jnp.where(j > 0, src(j + 3, slice(by - 1, by)),
+                                zero)
+                bot = jnp.where(j + 1 < nstr, src(j + 1, slice(0, 1)),
+                                zero)
+                new = sweep(cur, top, bot, rv, corr, inv_d)
+            if k == nsw:
+                out_ref[0] = new.astype(store_dtype)
+            else:
+                dst = lvls[k - 1]
+                slot = _rem(j, 4)
+                for s in range(4):
+                    # static-index stores (dynamic leading-index READS
+                    # are established idiom above; writes stay static)
+                    @pl.when(slot == s)
+                    def _(s=s):
+                        dst[s] = new.astype(store_dtype)
+
+
+def fused_jacobi_sweeps(e, r, omega, n, *, edge_signs=None,
+                        from_zero=False, interpret=None):
+    """n damped-Jacobi sweeps of the undivided 5-point zero-ghost
+    Laplacian in ONE strip pipeline: one HBM read of (e, r), one write
+    of the smoothed e — the fused form of the
+    MultigridPreconditioner._smooth sweep chain (which pays ~2n+1
+    field passes through XLA's fori_loop). Leading-dim agnostic like
+    the megakernel: [..., Ny, Nx] operands flatten to [L, Ny, Nx], so
+    one kernel serves the solo grid (L=1), fleet member batches (L=B)
+    and forest window stacks. ``edge_signs`` = the BC table's
+    (sx_lo, sx_hi, sy_lo, sy_hi) pressure-ghost signs; None =
+    all-Neumann. ``from_zero``: first sweep is the e = omega r / d
+    shortcut and ``e`` is ignored (may be None). Storage dtype follows
+    ``r`` (f32 or bf16); accumulation is always f32."""
+    lead = r.shape[:-2]
+    L = _flatten_lead(lead)
+    ny, nx = r.shape[-2:]
+    store = jnp.dtype(r.dtype)
+    by = _BY_BF16 if store == jnp.bfloat16 else _BY_F32
+    nstr = ny // by
+    nsw = int(n)
+    if interpret is None:
+        interpret = not _on_accel()
+    gs = ((1.0, 1.0, 1.0, 1.0) if edge_signs is None
+          else tuple(float(s) for s in edge_signs))
+    ops = [r.reshape((L, ny, nx))]
+    in_specs = [pl.BlockSpec(memory_space=pltpu.ANY)]
+    scratch = []
+    if not from_zero:
+        ops.append(e.astype(store).reshape((L, ny, nx)))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        scratch += [pltpu.VMEM((4, by, nx), store),
+                    pltpu.SemaphoreType.DMA((4,))]
+    rslots = nsw + 2
+    scratch += [pltpu.VMEM((rslots, by, nx), store),
+                pltpu.SemaphoreType.DMA((rslots,))]
+    for _ in range(nsw - 1):
+        scratch.append(pltpu.VMEM((4, by, nx), store))
+    kern = functools.partial(_jacobi_strips_kernel, by, nstr, ny, nx,
+                             nsw, float(omega), gs, from_zero, store)
+    out = pl.pallas_call(
+        kern,
+        grid=(L, nstr + nsw - 1),
+        in_specs=in_specs,
+        # block j revisited (unwritten) by the skew's fill steps, then
+        # written by sweep nsw at step j + nsw - 1 and flushed on the
+        # next index change — exactly once per strip
+        out_specs=pl.BlockSpec(
+            (1, by, nx),
+            lambda l, i: (l, jnp.maximum(i - (nsw - 1), 0), 0)),
+        out_shape=jax.ShapeDtypeStruct((L, ny, nx), store),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*ops)
+    return out.reshape(r.shape)
+
+
+# ---------------------------------------------------------------------------
+# sharded single-sweep halo strip (ISSUE 19): the strip-tier twin of
+# shard_halo.overlap_jacobi_sweeps' per-sweep body. The shard_map
+# wrapper ppermutes the 1-wide edge columns BEFORE dispatching (the
+# PR-16 pattern), each sweep then runs as one strip pipeline over the
+# local slab with the received columns riding a lane-padded ``aux``
+# operand — the chain cannot time-skew across sweeps (each sweep needs
+# fresh neighbor columns), so the sharded win is per-sweep fusion:
+# one read of (e, r) and one write per sweep instead of the GSPMD
+# chain's separate stencil/AXPY passes.
+# ---------------------------------------------------------------------------
+
+def _jacobi_halo_kernel(by, nstr, ny, nxl, omega, info_ref, r_ref,
+                        e_ref, aux_ref, out_ref, ering, esems, rring,
+                        rsems, aring, asems):
+    """One damped-Jacobi sweep over the local x slab [ny, nxl]; aux
+    [ny, 2*_GX] carries the received left edge column in [:, 0:1] and
+    right in [:, 1:2] (zeros at mesh walls = the zero ghost). info
+    (SMEM i32 [1, 2]) = (is_lo, is_hi) — the only per-shard values, so
+    all shards share one executable; the wall-diagonal x indicators
+    are masked by them exactly like the shard_map body's
+    device-index masks."""
+    i = pl.program_id(0)
+    f32 = jnp.float32
+
+    def edma(k):
+        slot = _rem(k, 4)
+        return pltpu.make_async_copy(
+            e_ref.at[pl.ds(k * by, by), :], ering.at[slot],
+            esems.at[slot])
+
+    def rdma(k):
+        slot = _rem(k, 2)
+        return pltpu.make_async_copy(
+            r_ref.at[pl.ds(k * by, by), :], rring.at[slot],
+            rsems.at[slot])
+
+    def adma(k):
+        slot = _rem(k, 2)
+        return pltpu.make_async_copy(
+            aux_ref.at[pl.ds(k * by, by), :], aring.at[slot],
+            asems.at[slot])
+
+    @pl.when(i == 0)
+    def _():
+        edma(0).start()
+        rdma(0).start()
+        adma(0).start()
+        if nstr > 1:
+            edma(1).start()
+
+    @pl.when(i + 2 < nstr)
+    def _():
+        edma(i + 2).start()
+
+    @pl.when(i + 1 < nstr)
+    def _():
+        rdma(i + 1).start()
+        adma(i + 1).start()
+
+    @pl.when(i == 0)
+    def _():
+        edma(0).wait()
+        if nstr > 1:
+            edma(1).wait()
+
+    @pl.when((i > 0) & (i + 1 < nstr))
+    def _():
+        edma(i + 1).wait()
+
+    rdma(i).wait()
+    adma(i).wait()
+
+    cur = ering[_rem(i, 4)].astype(f32)                  # [by, nxl]
+    prev_t = ering[_rem(i + 3, 4)][by - 1:by, :].astype(f32)
+    next_h = ering[_rem(i + 1, 4)][0:1, :].astype(f32)
+    zero = jnp.zeros((), f32)
+    top = jnp.where(i > 0, prev_t, zero)
+    bot = jnp.where(i + 1 < nstr, next_h, zero)
+    a = aring[_rem(i, 2)].astype(f32)
+    gl, gr = a[:, 0:1], a[:, 1:2]
+    xp = jnp.concatenate([cur[:, 1:], gr], axis=1)
+    xm = jnp.concatenate([gl, cur[:, :-1]], axis=1)
+    ecol = jnp.concatenate([top, cur, bot], axis=0)      # [by+2, nxl]
+    yp = ecol[2:, :]
+    ym = ecol[:-2, :]
+    is_lo = info_ref[0, 0]
+    is_hi = info_ref[0, 1]
+    col = jax.lax.broadcasted_iota(jnp.int32, (by, nxl), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (by, nxl), 0) + i * by
+    one = jnp.ones((), f32)
+    ix = jnp.where((col == 0) & (is_lo > 0), one,
+                   jnp.where((col == nxl - 1) & (is_hi > 0), one, zero))
+    iy = jnp.where(row == 0, one,
+                   jnp.where(row == ny - 1, one, zero))
+    corr = (iy + ix) - 4.0
+    inv_d = 1.0 / corr
+    rv = rring[_rem(i, 2)].astype(f32)
+    lap = xp + xm + yp + ym + cur * corr
+    out_ref[...] = (cur + omega * (rv - lap) * inv_d).astype(
+        out_ref.dtype)
+
+
+def fused_jacobi_halo_sweep(e, r, aux, info, omega, *, interpret=None):
+    """One sharded-slab Jacobi sweep (shard_map body helper): e, r
+    [ny, nxl] local slabs; aux [ny, 2*_GX] received edge columns;
+    info [1, 2] i32 (is_lo, is_hi). Neumann-only (the overlapped
+    sharded smoother is free-slip-specific, see
+    MultigridPreconditioner.__init__)."""
+    ny, nxl = e.shape
+    store = jnp.dtype(e.dtype)
+    by = _BY_BF16 if store == jnp.bfloat16 else _BY_F32
+    nstr = ny // by
+    if interpret is None:
+        interpret = not _on_accel()
+    kern = functools.partial(_jacobi_halo_kernel, by, nstr, ny, nxl,
+                             float(omega))
+    return pl.pallas_call(
+        kern,
+        grid=(nstr,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec((by, nxl), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((ny, nxl), store),
+        scratch_shapes=[
+            pltpu.VMEM((4, by, nxl), store),
+            pltpu.SemaphoreType.DMA((4,)),
+            pltpu.VMEM((2, by, nxl), store),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((2, by, 2 * _GX), aux.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(info, r, e, aux)
+
+
+# ---------------------------------------------------------------------------
+# fused forest block-Jacobi update (ISSUE 19): one sweep of the
+# composite smoother is e + P_inv (r - A e); the composite A-apply
+# stays XLA (it walks the level maps), but the smoother's OWN traffic
+# — residual subtract, the [N, bs^2] x [bs^2, bs^2] P_inv GEMM and the
+# update add, three separate XLA passes over the block stack — fuses
+# to one read of (e, r, Ae) and one write. Honest scope note: the
+# forest form is one-fused-pass-PER-SWEEP; only the uniform chain
+# above gets n-sweeps-one-pass.
+# ---------------------------------------------------------------------------
+
+def block_update_supported(dtype) -> bool:
+    """f32 block stacks only (Mosaic has no f64; the f64 forest
+    validation path stays on the XLA composition)."""
+    return HAVE_PALLAS and jnp.dtype(dtype) == jnp.float32
+
+
+def _block_jacobi_kernel(p_ref, e_ref, r_ref, lap_ref, out_ref):
+    d = r_ref[...] - lap_ref[...]                   # [cb, bs*bs]
+    z = jax.lax.dot_general(
+        d, p_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)         # d @ p_inv.T
+    out_ref[...] = e_ref[...] + z
+
+
+def fused_block_jacobi_update(e, r, lap, p_inv, *, interpret=None):
+    """e + P_inv (r - lap) over a [N, bs, bs] block stack in one fused
+    pass (the apply_block_precond_blocks composition, term-for-term:
+    (r - lap).reshape(N, bs^2) @ p_inv.T + e). The [N, bs, bs] ->
+    [N, bs^2] reshapes happen OUTSIDE the kernel (XLA bitcasts), so
+    the kernel body is pure 2-D MXU work riding the standard chunked
+    BlockSpec pipeline."""
+    n, bs, _ = e.shape
+    m = bs * bs
+    cb = _pick(n, (64, 32, 16, 8, 4, 2, 1))
+    if interpret is None:
+        interpret = not _on_accel()
+    out = pl.pallas_call(
+        _block_jacobi_kernel,
+        grid=(n // cb,),
+        in_specs=[
+            pl.BlockSpec((m, m), lambda i: (0, 0)),     # replicated
+            pl.BlockSpec((cb, m), lambda i: (i, 0)),
+            pl.BlockSpec((cb, m), lambda i: (i, 0)),
+            pl.BlockSpec((cb, m), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((cb, m), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, m), e.dtype),
+        interpret=interpret,
+    )(p_inv, e.reshape(n, m), r.reshape(n, m), lap.reshape(n, m))
+    return out.reshape(n, bs, bs)

@@ -268,6 +268,10 @@ class ShardedAMRSim(AMRSim):
         if not isinstance(tpois, ShardPoissonOp):
             return super()._fas_block_smoother(A, tpois)
         p_inv = self.p_inv
+        # tier threading (ISSUE 19): the Pallas latch routes each
+        # shard-local update tail through the fused block-Jacobi pass
+        # inside the overlapped shard_map (ppermutes still first).
+        tier = "strip" if self._kernel_tier != "xla" else "xla"
 
         def smooth(e, r, n, from_zero=False):
             if from_zero and n > 0:
@@ -275,7 +279,8 @@ class ShardedAMRSim(AMRSim):
                     apply_block_precond_blocks(r, p_inv))
                 n -= 1
             if n > 0:
-                e = overlap_block_jacobi_sweeps(e, r, p_inv, tpois, n)
+                e = overlap_block_jacobi_sweeps(e, r, p_inv, tpois, n,
+                                                tier=tier)
             return e
 
         return smooth

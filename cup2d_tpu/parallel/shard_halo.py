@@ -522,7 +522,7 @@ def exchange_padding_stats(t: HaloTables, n_pad: int, D: int,
 
 def overlap_jacobi_sweeps(e: jnp.ndarray, r: jnp.ndarray,
                           inv_d: jnp.ndarray, omega: float, n: int,
-                          mesh: Mesh) -> jnp.ndarray:
+                          mesh: Mesh, tier: str = "xla") -> jnp.ndarray:
     """``n`` damped-Jacobi sweeps of the undivided zero-Neumann 5-point
     Laplacian, ``e += omega (r - lap e) inv_d``, on [Ny, Nx] fields
     x-split over ``mesh`` — the smoothing kernel of the FAS multigrid
@@ -542,8 +542,21 @@ def overlap_jacobi_sweeps(e: jnp.ndarray, r: jnp.ndarray,
     (xp + xm + yp + ym + p*(edges - 4), ghosts zero, rank-1 edge
     correction), so the sharded sweep agrees with the single-device
     sweep to reordering roundoff (tests/test_poisson.py pins the
-    equivalence)."""
+    equivalence).
+
+    ``tier`` (ISSUE 19): the grid's smoother tier. "strip" routes each
+    sweep through the fused halo strip kernel
+    (pallas_kernels.fused_jacobi_halo_sweep) with the SAME
+    ppermute-before-dispatch structure — halo columns ride a
+    lane-padded aux operand into the kernel, per the PR-16
+    fused_advect_heun_sharded pattern; unsupported shapes fall back to
+    the GSPMD body below (identical result, an optimization gate)."""
     D = mesh.devices.size
+    if tier == "strip":
+        from ..ops import pallas_kernels as pk
+        nxl = int(e.shape[-1]) // int(D)
+        if pk.jacobi_strip_supported(int(e.shape[-2]), nxl, e.dtype, 1):
+            return _overlap_jacobi_sweeps_strip(e, r, omega, n, mesh)
 
     @partial(_shard_map, mesh=mesh,
              in_specs=(P(None, "x"),) * 3, out_specs=P(None, "x"))
@@ -584,6 +597,51 @@ def overlap_jacobi_sweeps(e: jnp.ndarray, r: jnp.ndarray,
         return jax.lax.fori_loop(0, n, sweep, e_loc)
 
     return run(e, r, inv_d)
+
+
+def _overlap_jacobi_sweeps_strip(e: jnp.ndarray, r: jnp.ndarray,
+                                 omega: float, n: int,
+                                 mesh: Mesh) -> jnp.ndarray:
+    """Strip-tier body of ``overlap_jacobi_sweeps``: per sweep, issue
+    the two edge-column ppermutes FIRST, then dispatch the fused halo
+    strip kernel over the local slab (one read of (e, r), one write per
+    sweep — the sharded chain cannot time-skew across sweeps because
+    each needs fresh neighbor columns). The wall-diagonal corr/inv_d
+    are rebuilt in-kernel from the (is_lo, is_hi) SMEM row, the same
+    values as the GSPMD body's device-index-masked indicators, so both
+    tiers agree to reordering roundoff."""
+    from ..ops import pallas_kernels as pk
+    D = mesh.devices.size
+    interpret = not pk._on_accel()
+    pad_w = 2 * pk._GX - 2
+
+    # check_rep=False: shard_map has no replication rule for
+    # pallas_call (the fused_advect_heun_sharded precedent)
+    @partial(_shard_map, mesh=mesh,
+             in_specs=(P(None, "x"),) * 2, out_specs=P(None, "x"),
+             check_rep=False)
+    def run(e_loc, r_loc):
+        idx = jax.lax.axis_index("x")
+        i32 = jnp.int32
+        info = jnp.stack([(idx == 0).astype(i32),
+                          (idx == D - 1).astype(i32)])[None, :]
+
+        def sweep(_, ee):
+            gl = jax.lax.ppermute(
+                ee[..., -1:], "x",
+                perm=[(d, d + 1) for d in range(D - 1)])
+            gr = jax.lax.ppermute(
+                ee[..., :1], "x",
+                perm=[(d + 1, d) for d in range(D - 1)])
+            aux = jnp.pad(jnp.concatenate([gl, gr], axis=-1),
+                          ((0, 0), (0, pad_w)))
+            return pk.fused_jacobi_halo_sweep(ee, r_loc, aux, info,
+                                              omega,
+                                              interpret=interpret)
+
+        return jax.lax.fori_loop(0, n, sweep, e_loc)
+
+    return run(e, r)
 
 
 # ---------------------------------------------------------------------------
@@ -801,7 +859,7 @@ def shard_poisson_op(op, n_pad: int, mesh: Mesh,
 
 def overlap_block_jacobi_sweeps(e: jnp.ndarray, r: jnp.ndarray,
                                 p_inv: jnp.ndarray, t: ShardPoissonOp,
-                                n: int) -> jnp.ndarray:
+                                n: int, tier: str = "xla") -> jnp.ndarray:
     """``n`` composite block-Jacobi sweeps ``e += P_inv (r - A e)`` on
     the block-sharded forest — the finest-level smoother of the forest
     FAS solver (poisson.ForestFASCycle via
@@ -822,11 +880,23 @@ def overlap_block_jacobi_sweeps(e: jnp.ndarray, r: jnp.ndarray,
     flux._structured_lap strip math over the same [own ++ received]
     gather space and the same GEMM, so sweeps agree with the
     single-shard_map-per-sweep form to the last bit
-    (tests/test_forest_mesh.py pins <= 1e-12)."""
+    (tests/test_forest_mesh.py pins <= 1e-12).
+
+    ``tier`` (ISSUE 19): "strip"/"fused" fuses the smoother's own
+    traffic — residual subtract, P_inv GEMM, update add — into one
+    Pallas pass per sweep (pallas_kernels.fused_block_jacobi_update),
+    dispatched AFTER the same exchange-first _structured_lap window;
+    f64 (and Pallas-less hosts) keep the XLA composition."""
     from ..flux import _structured_lap
+    use_fused = False
+    if tier != "xla":
+        from ..ops import pallas_kernels as pk
+        use_fused = pk.block_update_supported(e.dtype)
+        interpret = not pk._on_accel()
 
     @partial(_shard_map, mesh=t.mesh,
-             in_specs=(P("x"),) * 10 + (P(),) * 6, out_specs=P("x"))
+             in_specs=(P("x"),) * 10 + (P(),) * 6, out_specs=P("x"),
+             check_rep=not use_fused)
     def run(e0, r_loc, pack, nba, nbb, ms, mc, mf, mw, par,
             p_inv_r, wc0, wc1, mcl, mfr, d2own):
         pack = tuple(p[0] for p in pack)
@@ -844,6 +914,9 @@ def overlap_block_jacobi_sweeps(e: jnp.ndarray, r: jnp.ndarray,
             blocks = jnp.concatenate([ee, recv], axis=0)
             lap = _structured_lap(ee, blocks, nba, nbb, ms, mc, mf,
                                   mw, par, (wc0, wc1, mcl, mfr, d2own))
+            if use_fused:
+                return pk.fused_block_jacobi_update(
+                    ee, r_loc, lap, p_inv_r, interpret=interpret)
             z = ((r_loc - lap).reshape(B, bs_ * bs_)
                  @ p_inv_r.T).reshape(B, bs_, bs_)
             return ee + z

@@ -97,7 +97,8 @@ class MultigridPreconditioner:
                  nu2: int = 2, coarsest: int = 16, omega: float = 0.8,
                  cycle_dtype=None, spmd_safe: bool = False,
                  mesh=None, overlap_levels: int = 1,
-                 edge_signs=None):
+                 edge_signs=None, leg_dtype=None,
+                 smoother: str = "xla"):
         self.shapes = []
         self.nu1 = nu1
         self.nu2 = nu2
@@ -133,15 +134,55 @@ class MultigridPreconditioner:
         # effective HBM bandwidth and keeps the 8192^2 cycle inside HBM
         # (f32 temporaries alone exceeded it). f64 solves (CPU validation)
         # keep an f64 cycle for convergence-order tests.
-        self.dtype = cycle_dtype or (
+        #
+        # leg_dtype (ISSUE 19): the memory-tiered FAS-solver variant of
+        # the same tradeoff — when the cycle IS the solver
+        # (CUP2D_POIS=fas, cycle_dtype = the solver dtype), leg_dtype
+        # puts ONLY the cycle interior (smoother/transfer legs) in bf16
+        # while mg_solve's outer loop keeps the f32 true residual:
+        # iterative refinement, so the bf16 legs cannot floor the
+        # achievable residual the way a fully-bf16 solver does
+        # (BASELINE: ~2e-4 rel floor). Takes precedence over
+        # cycle_dtype when set.
+        self.leg_dtype = leg_dtype
+        self.dtype = leg_dtype or cycle_dtype or (
             jnp.bfloat16 if jnp.dtype(dtype) == jnp.float32 else dtype)
         self.out_dtype = dtype
+        ny0, nx0 = ny, nx
         while ny >= coarsest and nx >= coarsest \
                 and ny % 2 == 0 and nx % 2 == 0:
             self.shapes.append((ny, nx))
             ny //= 2
             nx //= 2
         self.shapes.append((ny, nx))
+        # smoother (ISSUE 19): "strip" fuses each sweep chain into one
+        # ring-buffered VMEM strip pipeline (pallas_kernels.
+        # fused_jacobi_sweeps — n sweeps = one HBM read + one write).
+        # Demoted to "xla" HERE when the finest level fails the shape
+        # gate, so the reported smoother_tier is always truthful;
+        # coarse levels and the 24-sweep coarsest chain fall back
+        # per-level inside _smooth (identical results either way).
+        if smoother == "strip":
+            from .ops.pallas_kernels import jacobi_strip_supported
+            if not jacobi_strip_supported(ny0, nx0, self.dtype,
+                                          max(nu1, nu2, 1)):
+                smoother = "xla"
+        self.smoother = smoother
+
+    @property
+    def smoother_tier(self) -> str:
+        """Telemetry label of the sweep-chain implementation: "xla" or
+        "strip", with "+bf16" suffixed when the cycle legs store bf16
+        (so a shape-gate demotion of the strip pipeline cannot hide an
+        armed bf16 leg tier — e.g. "xla+bf16"). The default bf16
+        PRECONDITIONER cycles (cycle_dtype=None under Krylov) keep the
+        bare label: that tier predates this latch and is carried by
+        poisson_mode."""
+        base = "strip" if self.smoother == "strip" else "xla"
+        if jnp.dtype(self.dtype) == jnp.bfloat16 and (
+                self.leg_dtype is not None or base == "strip"):
+            return base + "+bf16"
+        return base
 
     def _lap(self, p):
         """Undivided 5-point Laplacian, zero-Neumann edge ghosts —
@@ -174,6 +215,20 @@ class MultigridPreconditioner:
         return 1.0 / (ey[:, None] + ex[None, :] - 4.0)
 
     def _smooth(self, e, r, lvl, n, from_zero=False):
+        sharded = n > 0 and lvl < self.overlap_levels and r.ndim == 2
+        if self.smoother == "strip" and n > 0 and not sharded:
+            # strip tier (ISSUE 19): the whole sweep chain as ONE
+            # time-skewed strip pipeline — n sweeps cost one HBM read
+            # of (e, r) and one write instead of ~2n+1 field passes.
+            # Unsupported levels (coarse shapes, the 24-sweep coarsest
+            # chain) fall back to the identical-result XLA loop below.
+            from .ops.pallas_kernels import (fused_jacobi_sweeps,
+                                             jacobi_strip_supported)
+            ny, nx = self.shapes[lvl]
+            if jacobi_strip_supported(ny, nx, self.dtype, n):
+                return fused_jacobi_sweeps(e, r, self.omega, n,
+                                           edge_signs=self.edge_signs,
+                                           from_zero=from_zero)
         inv_d = self._inv_diag(lvl)
         # fori_loop (not Python unroll) so XLA reuses one sweep's buffers
         # across sweeps — unrolled at 8192^2 the live temporaries of all
@@ -185,10 +240,11 @@ class MultigridPreconditioner:
             n = n - 1
         if n > 0 and lvl < self.overlap_levels and r.ndim == 2:
             # sharded finest level(s): explicit edge-column ppermutes
-            # overlapped with the interior sweep (see __init__)
+            # overlapped with the interior sweep (see __init__); the
+            # strip tier rides the same halo form (ISSUE 19)
             from .parallel.shard_halo import overlap_jacobi_sweeps
             return overlap_jacobi_sweeps(e, r, inv_d, self.omega, n,
-                                         self.mesh)
+                                         self.mesh, tier=self.smoother)
         return jax.lax.fori_loop(
             0, n,
             lambda _, ee: ee + self.omega * (r - self._lap(ee)) * inv_d,
@@ -867,7 +923,8 @@ class ForestFASCycle:
 
     def __init__(self, A, smooth_blocks, paint_fine, base_solve,
                  extract_all, cih2, nu_img: int = 2,
-                 omega: float = 0.8, nu_pre: int = 1, nu_post: int = 1):
+                 omega: float = 0.8, nu_pre: int = 1, nu_post: int = 1,
+                 leg_dtype=None):
         self.A = A
         self.smooth_blocks = smooth_blocks
         self.paint_fine = paint_fine
@@ -878,6 +935,16 @@ class ForestFASCycle:
         self.omega = omega
         self.nu_pre = nu_pre
         self.nu_post = nu_post
+        # leg_dtype (ISSUE 19): storage dtype of the window-image
+        # ladder legs ONLY — the V-down/V-up smooths, restrictions and
+        # prolongations run in bf16 while mg_solve's outer loop keeps
+        # the f32 true residual (iterative refinement absorbs the leg
+        # rounding). The composite block smooth stays at solver
+        # precision (its P_inv GEMM is the accuracy-critical finest
+        # leg) and the DCT base solve stays f32 HIGHEST — the ladder
+        # casts its restricted RHS back up before entering it. None =
+        # solver-precision legs, bit-identical to the pre-tier cycle.
+        self.leg_dtype = leg_dtype
 
     def _img_smooth(self, e, r, n: int, from_zero: bool = False):
         # damped Jacobi on the Neumann-ghost window image; interior
@@ -898,6 +965,10 @@ class ForestFASCycle:
             r1 = r
         rdiv = r1 * self.cih2            # divided residual per block
         rimgs = self.paint_fine(rdiv)    # finest -> c+1, undivided
+        if self.leg_dtype is not None:
+            # bf16 ladder legs: one downcast per painted level; the
+            # whole V-down/V-up below then runs at leg precision
+            rimgs = [R.astype(self.leg_dtype) for R in rimgs]
         # V-down over the window-image levels: smooth, restrict the
         # smoothed residual one ladder step, fold in the next level's
         # own deposit (undivided restriction = sum-of-4)
@@ -913,14 +984,22 @@ class ForestFASCycle:
             rows = res[0::2, :] + res[1::2, :]
             racc = rows[:, 0::2] + rows[:, 1::2]
         # exact spectral base solve (folds the <= c deposits of rdiv
-        # in); awin = the window slice of the base correction
+        # in); awin = the window slice of the base correction. The
+        # base solve is precision-critical (f32 HIGHEST DCT) — leg
+        # storage casts back up at its door.
+        if self.leg_dtype is not None and racc is not None:
+            racc = racc.astype(rdiv.dtype)
         ec, awin = self.base_solve(rdiv, racc)
         # V-up: prolongate, add the stored level error, post-smooth
         # against the stored accumulated RHS
+        if self.leg_dtype is not None and len(rimgs) > 0:
+            awin = awin.astype(self.leg_dtype)
         for i in range(len(rimgs) - 1, -1, -1):
             a = _up2_bilinear(awin) + es[i]
             awin = self._img_smooth(a, accs[i], self.nu_img)
             es[i] = awin
+        if self.leg_dtype is not None:
+            es = [el.astype(rdiv.dtype) for el in es]
         corr = self.extract_all(ec, es)
         e = corr if e is None else e + corr
         return self.smooth_blocks(e, r, self.nu_post)

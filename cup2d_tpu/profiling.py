@@ -352,7 +352,7 @@ class HostCounters:
 # always present; fields that do not apply to a path (AMR shape on a
 # uniform run, comm volume on a single device, counters when disabled)
 # are null — consumers key on names, never on presence.
-METRICS_SCHEMA_VERSION = 10
+METRICS_SCHEMA_VERSION = 11
 METRICS_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     # solver health + timestep state (the step's existing diag pull).
@@ -385,6 +385,13 @@ METRICS_KEYS = (
     # dispatch through the _Pending lagged-commit rule like
     # poisson_mode
     "kernel_tier", "prec_mode",
+    # smoother-tier attribution (schema v11, ISSUE 19): the pressure
+    # hierarchy's sweep-chain implementation latch (drivers'
+    # .smoother_tier — xla | strip | strip+bf16, with "+bf16"
+    # suffixing whichever base the shape gate left armed), riding the
+    # same diag-then-driver pull as kernel_tier, so a memory-tiered
+    # FAS A/B run is attributable from metrics.jsonl alone
+    "smoother_tier",
     # boundary-condition attribution (schema v8, ISSUE 12): the
     # driver's compact per-face BCTable token string (.bc_table — e.g.
     # "fs,fs,fs,fs" legacy box, "ns,ns,ns,ns(1,0)" lid-driven cavity)
@@ -582,7 +589,8 @@ class MetricsRecorder:
         # (schema v8): same diag-then-driver pull as poisson_mode —
         # host strings from constructor latches (.bc_table is the
         # table's token string, .case the case-registry tag)
-        for key in ("kernel_tier", "prec_mode", "bc_table", "case"):
+        for key in ("kernel_tier", "prec_mode", "smoother_tier",
+                    "bc_table", "case"):
             kv = diag.get(key)
             if kv is None and sim is not None:
                 kv = getattr(sim, key, None)
@@ -921,6 +929,11 @@ def summarize_metrics(records: list) -> dict:
         # run's steps took (the trigger can flip mid-run) + cycle cost
         "poisson_modes": (sorted({str(m) for m in col("poisson_mode")})
                           or None),
+        # smoother-tier attribution (schema v11): distinct sweep-chain
+        # implementations the run's solves used, like poisson_modes
+        "smoother_tiers": (sorted({str(m)
+                                   for m in col("smoother_tier")})
+                           or None),
         "precond_cycles": stats(col("precond_cycles")),
         "energy_first": energy[0] if energy else None,
         "energy_last": energy[-1] if energy else None,

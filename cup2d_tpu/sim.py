@@ -158,6 +158,11 @@ class Simulation(ShapeHostMixin):
         return self.grid.prec_mode
 
     @property
+    def smoother_tier(self) -> str:
+        """Pressure-hierarchy smoother tier (telemetry schema v11)."""
+        return self.grid.smoother_tier
+
+    @property
     def bc_table(self) -> str:
         """Per-face BC token string (telemetry schema v8)."""
         return self.grid.bc_table

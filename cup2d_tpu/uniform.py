@@ -244,11 +244,33 @@ class UniformGrid:
         # bench target). f32 cycles double the per-cycle bytes; the
         # solve spends 2-4 cycles total vs Krylov's 2 M-applies x 8-11
         # iterations, so the byte TOTAL still drops.
+        #
+        # Memory-tiered FAS (ISSUE 19): the CUP2D_PREC/CUP2D_PALLAS
+        # composition extends to the SOLVER side of the fas latch —
+        # bf16 lives on the cycle's smoother/transfer LEGS only
+        # (leg_dtype), while mg_solve's outer loop keeps the f32 true
+        # residual (iterative refinement: the legs cannot floor the
+        # solve the way the fully-bf16 solver above does), and the
+        # Pallas latch arms the fused strip smoother (one HBM pass per
+        # sweep chain). Both are demoted truthfully by the
+        # MultigridPreconditioner shape gate; prec=bf16 without the
+        # Pallas tier already refused above.
+        self._fas_leg_dtype = (
+            jnp.bfloat16
+            if (prec == "bf16" and self.solver_mode == "fas")
+            else None)
+        self._mg_smoother = (
+            "strip"
+            if (self._kernel_tier != "xla"
+                and self.solver_mode == "fas")
+            else "xla")
         self.mg = MultigridPreconditioner(
             self.ny, self.nx, self.dtype, spmd_safe=spmd_safe,
             cycle_dtype=(self.dtype if self.solver_mode == "fas"
                          else None),
-            edge_signs=self._psigns)
+            edge_signs=self._psigns,
+            leg_dtype=self._fas_leg_dtype,
+            smoother=self._mg_smoother)
         # f64 dot-product accumulation when fields are f32 AND x64 is
         # available (the Krylov scalars are precision-critical, SURVEY.md §7
         # hard part 5). Without x64, XLA's tree reduction keeps f32 error at
@@ -366,6 +388,15 @@ class UniformGrid:
             self.dtype.name, self.dtype.name)
 
     @property
+    def smoother_tier(self) -> str:
+        """Active smoother tier of the pressure hierarchy (telemetry
+        schema v11): ``xla`` (sweep-chain lowered by XLA), ``strip``
+        (fused Pallas strip pipeline, f32 legs), or ``strip+bf16``
+        (strip pipeline over bf16-storage legs). Reported by the
+        preconditioner itself so shape-gate demotions stay truthful."""
+        return self.mg.smoother_tier
+
+    @property
     def bc_table(self) -> str:
         """Compact per-face BC token string (telemetry schema v8)."""
         return self.bc.token
@@ -386,7 +417,9 @@ class UniformGrid:
                 self.ny, self.nx, self.dtype,
                 spmd_safe=self.spmd_safe, mesh=mesh,
                 cycle_dtype=self.dtype,
-                edge_signs=self._psigns)
+                edge_signs=self._psigns,
+                leg_dtype=self._fas_leg_dtype,
+                smoother=self._mg_smoother)
 
     def pressure_solve(self, rhs: jnp.ndarray, exact: bool = False):
         """Solve lap(dp) = rhs (undivided). ``exact`` reproduces the
@@ -612,6 +645,11 @@ class UniformSim:
     def prec_mode(self) -> str:
         """Hot-loop storage precision (telemetry schema v6)."""
         return self.grid.prec_mode
+
+    @property
+    def smoother_tier(self) -> str:
+        """Pressure-hierarchy smoother tier (telemetry schema v11)."""
+        return self.grid.smoother_tier
 
     @property
     def bc_table(self) -> str:

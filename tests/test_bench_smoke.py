@@ -108,10 +108,28 @@ def test_bench_cpu_smoke():
     pc = out["poisson_curve"]
     assert "error" not in pc, pc
     assert set(pc["paths"]) == {"bicgstab_jacobi", "bicgstab_mg",
-                                "fas_v", "fas_f"}
+                                "fas_v", "fas_f",
+                                "fas_v+strip", "fas_v+bf16leg"}
     for name, p in pc["paths"].items():
         assert p["converged"], (name, p)
         assert p["iters"] >= 1 and p["ms_per_solve"] > 0, (name, p)
+        # roofline fields (ISSUE 19, kernel_curve methodology): every
+        # arm carries the modeled passes/bytes + derived util/MFU
+        assert set(p) >= {"hbm_passes", "hbm_bytes", "hbm_util_pct",
+                          "mfu_pct"}, (name, p)
+    # memory-tiered FAS acceptance (ISSUE 19): the bf16-leg strip arm
+    # models >= ~2x fewer bytes/cycle than the XLA f32 chain while
+    # converging by the SAME f32 true-residual criterion with iters
+    # within +1 of the f32-leg arm; the strip tiers report themselves
+    assert (pc["paths"]["fas_v"]["hbm_bytes"]
+            >= 2.0 * pc["paths"]["fas_v+bf16leg"]["hbm_bytes"]), pc
+    assert (pc["paths"]["fas_v+bf16leg"]["iters"]
+            <= pc["paths"]["fas_v"]["iters"] + 1), pc
+    assert (pc["paths"]["fas_v+strip"]["iters"]
+            <= pc["paths"]["fas_v"]["iters"] + 1), pc
+    assert pc["paths"]["fas_v+strip"]["smoother_tier"] == "strip", pc
+    assert (pc["paths"]["fas_v+bf16leg"]["smoother_tier"]
+            == "strip+bf16"), pc
     # composite-forest solve-path block (PR 13): the three forest arms
     # each ran a real converged production solve on the multi-level
     # topology. ms/solve ordering is timing-noise-prone on a shared CI

@@ -76,14 +76,18 @@ def _amr_sim():
 # the rung attribution of elastic recoveries, PR 17); v10 the
 # flight-recorder gauges (span_count / compile_ms_total /
 # hbm_exec_bytes — the tracing.FlightRecorder span ring and
-# compile/memory ledger, PR 18).
-_SCHEMA_V10_KEYS = (
+# compile/memory ledger, PR 18); v11 the smoother-tier attribution
+# (smoother_tier — the pressure hierarchy's sweep-chain latch, xla |
+# strip | strip+bf16 with "+bf16" suffixing whatever base the shape
+# gate left armed, ISSUE 19).
+_SCHEMA_V11_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     "umax", "dt_next",
     "poisson_iters", "poisson_residual",
     "poisson_converged", "poisson_stalled",
     "poisson_mode", "precond_cycles",
     "kernel_tier", "prec_mode",
+    "smoother_tier",
     "bc_table", "case",
     "energy", "div_linf",
     "n_blocks", "blocks_per_level", "refines", "coarsens",
@@ -100,15 +104,15 @@ _SCHEMA_V10_KEYS = (
 )
 
 
-def test_metrics_schema_v10_key_set_pinned():
+def test_metrics_schema_v11_key_set_pinned():
     from cup2d_tpu.profiling import METRICS_SCHEMA_VERSION
-    assert METRICS_SCHEMA_VERSION == 10
-    assert METRICS_KEYS == _SCHEMA_V10_KEYS
+    assert METRICS_SCHEMA_VERSION == 11
+    assert METRICS_KEYS == _SCHEMA_V11_KEYS
 
 
 @pytest.mark.slow   # ~17 s; duplicative tier-1 coverage: the frozen key
 #                     SET is pinned as a literal tuple in
-#                     test_metrics_schema_v10_key_set_pinned and the
+#                     test_metrics_schema_v11_key_set_pinned and the
 #                     uniform producer stream (every record, key-exact)
 #                     in test_cli_metrics_stream_and_post_report; the
 #                     AMR/bench records drilled here ride the identical

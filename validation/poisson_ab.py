@@ -17,6 +17,14 @@ path:
             preconditioned Krylov iteration)
   fas-f     same hierarchy, every solve opened base-level-first
             (CUP2D_POIS=fas-f)
+  fas-bf16leg
+            the memory-tiered cycle (ISSUE 19): same fas hierarchy
+            with the window-image ladder legs stored bf16
+            (CUP2D_PREC=bf16 + CUP2D_POIS=fas in production; pinned
+            directly here like the other arms). mg_solve's outer loop
+            keeps the solver-precision true residual, so the
+            acceptance claim is iters within +1 of the fas arm at the
+            SAME convergence criterion
 
 Iteration counts are platform-independent (the loop is the same XLA
 program everywhere), so this probe runs anywhere; ms/step numbers are
@@ -182,12 +190,17 @@ def run_path(path: str, bpd: int, steps: int, synthetic: int = 0,
     if path == "jacobi":
         sim._coarse_on = False       # the trigger-off default
         use = False
-    elif path in ("fas", "fas-f"):
+    elif path in ("fas", "fas-f", "fas-bf16leg"):
         # the forest-FAS full-solve arms: pin the CUP2D_POIS latch
         # slot directly (fresh sim, first trace sees it — the same
         # post-construction pinning discipline as _twolevel_form) and
-        # force-engage the hierarchy maps like _use_coarse would
-        sim._pois_mode = path
+        # force-engage the hierarchy maps like _use_coarse would.
+        # fas-bf16leg additionally pins the ISSUE-19 leg-dtype latch
+        # (production: CUP2D_PREC=bf16 at construction)
+        sim._pois_mode = "fas" if path == "fas-bf16leg" else path
+        if path == "fas-bf16leg":
+            import jax.numpy as jnp
+            sim._fas_leg_dtype = jnp.bfloat16
         sim._coarse_on = True
         use = True
     else:
@@ -213,6 +226,7 @@ def run_path(path: str, bpd: int, steps: int, synthetic: int = 0,
     return {
         "path": path,
         "n_blocks": int(sim._n_real),
+        "smoother_tier": sim.smoother_tier,
         "iters": iters,
         "residual": res,
         "converged": conv,
@@ -226,7 +240,8 @@ def main():
     ap.add_argument("--bpd", type=int, default=8)
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--paths",
-                    default="jacobi,additive,mult,mg2,fas,fas-f")
+                    default="jacobi,additive,mult,mg2,fas,fas-f,"
+                            "fas-bf16leg")
     ap.add_argument("--synthetic", type=int, default=0,
                     help="use the BASELINE 1e4-regime synthetic forest "
                          "adapted to >= this many blocks")
