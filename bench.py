@@ -723,7 +723,14 @@ def run_poisson_curve(size: int, tol_rel: float = 1e-3,
     sweep updates) — the fields track cross-round MOVEMENT, and the
     pinned acceptance is the fas_v : fas_v+bf16leg byte ratio >= 2 at
     iters within +1. util percentages are meaningless in
-    interpret_mode (flagged), exactly like run_kernel_curve."""
+    interpret_mode (flagged), exactly like run_kernel_curve.
+
+    Direct arms (ISSUE 20): fftd_periodic (doubly-periodic box, pure
+    spectral divide) and fftd_channel (periodic-x/no-slip-y, per-mode
+    Thomas systems) time poisson.fft_diag_solve on their own periodic
+    grids + cold mean-free RHS at the same relative criterion —
+    iters == 1 by contract, and the round-14 acceptance pins
+    fftd_periodic ms_per_solve below the best fas arm's."""
     from cup2d_tpu.config import SimConfig
     from cup2d_tpu.ops.stencil import divergence_rhs
     from cup2d_tpu.poisson import (MultigridPreconditioner, bicgstab,
@@ -830,6 +837,59 @@ def run_poisson_curve(size: int, tol_rel: float = 1e-3,
         }
         if name in tier_label:
             paths[name]["smoother_tier"] = tier_label[name]
+
+    # FFT-diagonalized direct arms (ISSUE 20): each gets its OWN
+    # periodic grid and cold RHS — the wall-table RHS above belongs to
+    # a different operator — under the SAME fence methodology and
+    # relative Linf criterion. The plan is constructed EXPLICITLY
+    # (not via the CUP2D_POIS latch), the PR-6 contamination rule.
+    # iters == 1 is the direct-solve contract; the acceptance compares
+    # ms_per_solve against the best fas arm above. The bytes model is
+    # as coarse as the others': rfft2+divide+irfft2 ~ 2 passes per 1-D
+    # transform stage + the pointwise stage; the tridiag arm swaps one
+    # transform pair for the two first-order Thomas scans.
+    from cup2d_tpu.cases import periodic_channel_table, periodic_table
+    from cup2d_tpu.poisson import FFTDiagPlan, fft_diag_solve
+
+    for name, table in (("fftd_periodic", periodic_table()),
+                        ("fftd_channel", periodic_channel_table())):
+        gp = UniformGrid(cfg, level=level, bc=table)
+        sp = bench_state(gp)
+        bp = gp.poisson_rhs(sp.vel, None, sp.udef, dt)
+        bp = bp - jnp.mean(bp)       # cold mean-free RHS (the
+        #                              projection pipeline's contract)
+        px, py = gp._paxes
+        plan = FFTDiagPlan(gp.ny, gp.nx, gp.dtype, px, py, gp._psigns)
+        solve = lambda bb, gp=gp, plan=plan: fft_diag_solve(
+            gp.laplacian, bb, plan, tol=0.0, tol_rel=tol_rel)
+        js = jax.jit(solve)
+        res = js(bp)
+        _fence(res.x)
+        t0 = time.perf_counter()
+        for _ in range(n_rep):
+            res = js(bp)
+            _fence(res.x)
+        wall = max((time.perf_counter() - t0 - n_rep * lat) / n_rep,
+                   1e-9)
+        passes, flops_cell = ((10.0, 120.0) if name == "fftd_periodic"
+                              else (12.0, 80.0))
+        norm0p = float(jnp.max(jnp.abs(bp)))
+        sec = max(wall, 1e-12)
+        paths[name] = {
+            "iters": int(res.iters),
+            "ms_per_solve": round(wall * 1e3, 3),
+            "ms_per_iter": round(wall * 1e3, 3),
+            "residual_rel": float(res.residual) / norm0p,
+            "converged": bool(res.converged),
+            "bc_table": table.token,
+            "hbm_passes": passes,
+            "hbm_bytes": passes * fb,
+            "hbm_util_pct": round(
+                passes * fb / sec / (PEAK_HBM_GBPS * 1e9) * 100.0, 3),
+            "mfu_pct": round(
+                flops_cell * cells / sec
+                / (PEAK_F32_TFLOPS * 1e12) * 100.0, 3),
+        }
     return {"grid": f"{size}x{size}", "tol_rel": tol_rel,
             "interpret_mode": not _on_accel(),
             "anchors_r04": {"mfu_pct": 0.95, "hbm_util_pct": 12.0},

@@ -188,6 +188,17 @@ class AMRSim(ShapeHostMixin):
         # DCT-II base solve; fas-f opens every solve base-level-first).
         # Exact/escalation solves keep Krylov as the robustness
         # backstop, exactly like the uniform path.
+        # "fftd" (ISSUE 20) is a UNIFORM-FAMILY token: the FFT
+        # diagonalization needs a periodic single-level box, and the
+        # forest refuses every non-free-slip table above anyway —
+        # name the token explicitly so a mixed-process env latch
+        # fails with the reason, not a generic typo message.
+        if self._pois_mode == "fftd":
+            raise ValueError(
+                "CUP2D_POIS=fftd is a uniform-family solve (FFT "
+                "diagonalization over a periodic single-level box); "
+                "AMRSim's forest has no periodic gather-table ghosts "
+                "— run periodic cases on UniformSim/FleetSim")
         if self._pois_mode not in ("structured", "tables", "fft",
                                    "fas", "fas-f"):
             raise ValueError(

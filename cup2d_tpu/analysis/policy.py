@@ -32,7 +32,10 @@ ENV_LATCH_SITES = {
     # A/B gates latched per-sim in the constructor (ADVICE r5).
     # CUP2D_POIS mode values: structured|tables|fft|fas|fas-f on the
     # forest (AMRSim validates; fas/fas-f select the forest-native FAS
-    # full solver since PR 13), and fas|fas-f on the uniform family —
+    # full solver since PR 13, and fftd refuses by name — uniform-only),
+    # and fas|fas-f|fftd on the uniform family (fftd, ISSUE 20: the
+    # FFT-diagonalized direct solve is a VALUE of the existing latch,
+    # NOT a new read site — tests/test_analysis.py pins that) —
     # the UniformGrid constructor is the ONE uniform-side latch;
     # fleet.py and the parallel/ modules read the GRID's stored latch
     # and stay env-read-free (the package walk enforces it).
@@ -158,8 +161,13 @@ LEADING_DIM_SCOPES = {
     # mg_solve is the fused fleet cycle loop; project_correct is the
     # shared epilogue over any leading shape; bicgstab carries the
     # member axis through its Krylov state
+    # fft_diag_solve / FFTDiagPlan (ISSUE 20): the FFT-diagonalized
+    # direct solve batches B fleet systems through the one transform
+    # ([B, Ny, Nx] — trailing-axes rffts, the Thomas scans broadcast
+    # the precomputed [n_s, nk] elimination constants over any lead)
     "poisson.py": ("MultigridPreconditioner", "mg_solve",
-                   "project_correct", "bicgstab"),
+                   "project_correct", "bicgstab",
+                   "fft_diag_solve", "FFTDiagPlan"),
     # host-side wrappers of the fused tier: normalize ANY leading shape
     # to the kernel's flat [L, ...] layout — the flattening itself must
     # not assume a rank (kernel bodies below them see fixed block

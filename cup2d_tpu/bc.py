@@ -27,6 +27,11 @@ velocity ghosts (``pad_vector_bc``)
     outflow     g = edge + c*(edge - inner), c = clip(u_n*dt/h, 0, 1)
                 (local-advection-speed extrapolation; c=0 when no dt
                 is available, i.e. plain zeroth-order extrapolation)
+    periodic    g = wrap: the ghost layers are copies of the opposite
+                side's interior lines (``np.roll`` semantics, ISSUE
+                20). Periodic faces come in PAIRS (validate()); the
+                y-then-x paint order makes the corner ghosts compose
+                exactly like rolling the y-treated field along x.
 
 pressure (per-face sign ``s``, see ``pressure_signs``)
     free_slip / no_slip / inflow -> homogeneous Neumann, s = +1
@@ -35,6 +40,10 @@ pressure (per-face sign ``s``, see ``pressure_signs``)
     outflow -> homogeneous Dirichlet at the mid-face, s = -1
         (ghost p = -edge p => p = 0 on the face; the outflow face owns
         the pressure level, removing the all-Neumann nullspace)
+    periodic -> s = 0: no edge correction at all — the missing
+        neighbor is the WRAPPED interior cell, supplied by the
+        periodic (roll) shifts in ops/stencil (``periodic_axes``
+        flags), keeping the SPD row structure intact.
 
 divergence (undivided central form, ``ops/stencil.divergence_bc``)
     free_slip / no_slip / inflow keep the legacy edge coefficients
@@ -43,11 +52,15 @@ divergence (undivided central form, ``ops/stencil.divergence_bc``)
     the ghost 2*uw - edge splits into the legacy mirror part plus the
     constant — ``divergence_affine_bc``). outflow extrapolates
     (ghost = edge) so the coefficient flips sign (lo=-1, hi=+1).
+    periodic contributes coefficient 0: the wrap shift supplies the
+    opposite-side neighbor directly.
 
 Tables with any outflow face yield a NON-singular Poisson operator:
 ``project_correct`` must then skip its mean-pressure removal
 (``BCTable.all_neumann`` gates it). All-Neumann non-free-slip tables
-(the lid-driven cavity) keep the legacy nullspace handling.
+(the lid-driven cavity) keep the legacy nullspace handling, and so do
+periodic tables — a fully-periodic box (and the periodic channel)
+retains the constant nullspace, so mean removal stays on.
 
 The default table ``FREE_SLIP`` routes every consumer through the
 UNMODIFIED legacy code paths — bit-identity with rounds 1-11 is a
@@ -60,7 +73,7 @@ from typing import NamedTuple, Optional, Tuple
 
 import jax.numpy as jnp
 
-_KINDS = ("free_slip", "no_slip", "inflow", "outflow")
+_KINDS = ("free_slip", "no_slip", "inflow", "outflow", "periodic")
 # (x_lo, x_hi, y_lo, y_hi): index of the wall-NORMAL velocity component
 # (vel layout [2, Ny, Nx]: component 0 = u, component 1 = v)
 _FACES = ("x_lo", "x_hi", "y_lo", "y_hi")
@@ -100,6 +113,10 @@ def convective_outflow() -> FaceBC:
     return FaceBC("outflow")
 
 
+def periodic() -> FaceBC:
+    return FaceBC("periodic")
+
+
 class BCTable(NamedTuple):
     """Per-face boundary-condition table — the single source of truth
     for domain-edge treatment across ghost paint, divergence, Poisson
@@ -120,6 +137,16 @@ class BCTable(NamedTuple):
                 raise ValueError(
                     f"BCTable.{name}: unknown kind {f.kind!r} "
                     f"(expected one of {_KINDS})")
+        # periodic is a PAIRED treatment: a wrap ghost on one face
+        # reads the opposite face's interior, so both must agree or
+        # the paint would silently invent a half-periodic domain
+        for lo, hi in (("x_lo", "x_hi"), ("y_lo", "y_hi")):
+            klo = getattr(self, lo).kind
+            khi = getattr(self, hi).kind
+            if (klo == "periodic") != (khi == "periodic"):
+                raise ValueError(
+                    f"BCTable: periodic faces must be paired — "
+                    f"{lo} is {klo!r} but {hi} is {khi!r}")
         return self
 
     @property
@@ -144,7 +171,7 @@ class BCTable(NamedTuple):
         is ``fs,fs,fs,fs``, the lid-driven cavity
         ``ns,ns,ns,ns(1,0)``."""
         short = {"free_slip": "fs", "no_slip": "ns",
-                 "inflow": "in", "outflow": "out"}
+                 "inflow": "in", "outflow": "out", "periodic": "pd"}
         toks = []
         for f in self:
             # unknown kinds pass through verbatim so diagnostics that
@@ -171,21 +198,37 @@ def pressure_signs(bc: BCTable) -> Tuple[float, float, float, float]:
     """Per-face pressure-ghost sign (x_lo, x_hi, y_lo, y_hi):
     +1 homogeneous Neumann (ghost = edge) for prescribed-velocity
     faces, -1 homogeneous Dirichlet (ghost = -edge, p=0 mid-face) for
-    convective outflow. Feeds laplacian5_bc edge indicators, the
-    smoother diagonal and the gradient edge coefficients."""
-    return tuple(-1.0 if f.kind == "outflow" else 1.0 for f in bc)
+    convective outflow, 0 for periodic (no edge correction — the wrap
+    shift supplies the opposite-side neighbor, see ``periodic_axes``).
+    Feeds laplacian5_bc edge indicators, the smoother diagonal and the
+    gradient edge coefficients."""
+    sign = {"outflow": -1.0, "periodic": 0.0}
+    return tuple(sign.get(f.kind, 1.0) for f in bc)
 
 
 def divergence_coeffs(bc: BCTable) -> Tuple[float, float, float, float]:
     """Per-face edge coefficient of the wall-NORMAL velocity in the
     undivided central divergence (x_lo, x_hi, y_lo, y_hi). Mirror and
     2*uw-edge ghosts keep the legacy (+1, -1) pattern; extrapolated
-    outflow ghosts flip it."""
+    outflow ghosts flip it; periodic faces contribute 0 (wrap shift
+    supplies the neighbor directly)."""
     lo = {True: -1.0, False: 1.0}
-    return (lo[bc.x_lo.kind == "outflow"],
-            -lo[bc.x_hi.kind == "outflow"],
-            lo[bc.y_lo.kind == "outflow"],
-            -lo[bc.y_hi.kind == "outflow"])
+
+    def c(face, flip):
+        if face.kind == "periodic":
+            return 0.0
+        return flip * lo[face.kind == "outflow"]
+
+    return (c(bc.x_lo, 1.0), c(bc.x_hi, -1.0),
+            c(bc.y_lo, 1.0), c(bc.y_hi, -1.0))
+
+
+def periodic_axes(bc: BCTable) -> Tuple[bool, bool]:
+    """(px, py): whether the x / y direction is periodic. Paired-face
+    validation guarantees lo and hi agree, so the lo face speaks for
+    the axis. Threaded to the ops/stencil ``*_bc`` forms, which switch
+    the corresponding zero-ghost shifts to wrap (roll) shifts."""
+    return (bc.x_lo.kind == "periodic", bc.y_lo.kind == "periodic")
 
 
 def _profile_1d(face: FaceBC, n: int, dtype):
@@ -309,30 +352,44 @@ def pad_vector_bc(v: jnp.ndarray, g: int, bc: BCTable, h: float,
         return (edge_u + c * (edge_u - inner_u),
                 edge_v + c * (edge_v - inner_v))
 
-    # y faces first, interior columns (normal component = v = index 1)
-    f = bc.y_lo
-    uw = _face_wall(f, nx, v.dtype, along_rows=False) \
-        if f.kind in ("no_slip", "inflow") else (0.0, 0.0)
-    gu, gv = ghost(f, v[..., 0:1, :1, :], v[..., 1:2, :1, :],
-                   v[..., 0:1, 1:2, :], v[..., 1:2, 1:2, :], 1, -1.0, uw)
-    out = out.at[..., 0:1, :g, g:-g].set(
-        jnp.broadcast_to(gu, gu.shape[:-2] + (g, nx)))
-    out = out.at[..., 1:2, :g, g:-g].set(
-        jnp.broadcast_to(gv, gv.shape[:-2] + (g, nx)))
-    f = bc.y_hi
-    uw = _face_wall(f, nx, v.dtype, along_rows=False) \
-        if f.kind in ("no_slip", "inflow") else (0.0, 0.0)
-    gu, gv = ghost(f, v[..., 0:1, -1:, :], v[..., 1:2, -1:, :],
-                   v[..., 0:1, -2:-1, :], v[..., 1:2, -2:-1, :], 1, 1.0,
-                   uw)
-    out = out.at[..., 0:1, -g:, g:-g].set(
-        jnp.broadcast_to(gu, gu.shape[:-2] + (g, nx)))
-    out = out.at[..., 1:2, -g:, g:-g].set(
-        jnp.broadcast_to(gv, gv.shape[:-2] + (g, nx)))
+    # y faces first, interior columns (normal component = v = index 1).
+    # Periodic y paints BOTH strips at once: the ghost layers are the
+    # opposite side's interior lines (wrap, not a broadcast line), and
+    # paired-face validation means y_lo periodic <=> y_hi periodic.
+    if bc.y_lo.kind == "periodic":
+        out = out.at[..., :g, g:-g].set(v[..., ny - g:, :])
+        out = out.at[..., -g:, g:-g].set(v[..., :g, :])
+    else:
+        f = bc.y_lo
+        uw = _face_wall(f, nx, v.dtype, along_rows=False) \
+            if f.kind in ("no_slip", "inflow") else (0.0, 0.0)
+        gu, gv = ghost(f, v[..., 0:1, :1, :], v[..., 1:2, :1, :],
+                       v[..., 0:1, 1:2, :], v[..., 1:2, 1:2, :], 1, -1.0,
+                       uw)
+        out = out.at[..., 0:1, :g, g:-g].set(
+            jnp.broadcast_to(gu, gu.shape[:-2] + (g, nx)))
+        out = out.at[..., 1:2, :g, g:-g].set(
+            jnp.broadcast_to(gv, gv.shape[:-2] + (g, nx)))
+        f = bc.y_hi
+        uw = _face_wall(f, nx, v.dtype, along_rows=False) \
+            if f.kind in ("no_slip", "inflow") else (0.0, 0.0)
+        gu, gv = ghost(f, v[..., 0:1, -1:, :], v[..., 1:2, -1:, :],
+                       v[..., 0:1, -2:-1, :], v[..., 1:2, -2:-1, :], 1,
+                       1.0, uw)
+        out = out.at[..., 0:1, -g:, g:-g].set(
+            jnp.broadcast_to(gu, gu.shape[:-2] + (g, nx)))
+        out = out.at[..., 1:2, -g:, g:-g].set(
+            jnp.broadcast_to(gv, gv.shape[:-2] + (g, nx)))
 
     # x faces over FULL rows, reading the y-painted columns so corners
-    # compose (normal component = u = index 0)
+    # compose (normal component = u = index 0). Periodic x wraps the
+    # y-painted columns — identical to rolling the y-treated field
+    # along x, so corner ghosts match the np.roll reference exactly.
     nyp = ny + 2 * g
+    if bc.x_lo.kind == "periodic":
+        out = out.at[..., :, :g].set(out[..., :, nx:nx + g])
+        out = out.at[..., :, -g:].set(out[..., :, g:2 * g])
+        return out
     f = bc.x_lo
     uw = _x_face_wall_padded(f, ny, g, v.dtype)
     gu, gv = ghost(f, out[..., 0:1, :, g:g + 1], out[..., 1:2, :, g:g + 1],

@@ -34,6 +34,30 @@ Catalog:
     validation/cylinder.py runs through the same ``-case`` path it
     validates.
 
+``tgv_periodic``
+    Doubly-periodic Taylor-Green vortex (ISSUE 20): u = U sin(kx)
+    cos(ky), v = -U cos(kx) sin(ky), k = 2pi/L on the unit box. The
+    ONE periodic case with a closed-form answer — kinetic energy
+    decays as exp(-4 nu k^2 t) — so it anchors both the wrap-ghost
+    paint and the fftd direct solve against analysis, not another
+    solver. Obstacle-free and fleet-servable (the sampled IC is
+    discretely divergence-free under the centered stencils, and the
+    all-periodic table keeps the mean-free pressure contract).
+
+``shear_layer``
+    Doubly-periodic double shear layer (Bell-Colella-Glaz): two tanh
+    layers at y = 1/4 and 3/4 with a delta*sin(2pi x) vertical
+    perturbation that rolls them up into the classic vortex pairs.
+    The standard stress test for periodic advection + projection.
+
+``turb2d``
+    Seeded decaying 2D turbulence: random-phase vorticity spectrum
+    E(k) ~ k / (1 + (k/k0)^4), velocity synthesized host-side from
+    the streamfunction by CENTERED differences (discretely
+    divergence-free by construction — Dx Dy psi == Dy Dx psi).
+    Deterministic per seed; fleet members get seed + slot so a
+    member-batched fleet serves an ensemble.
+
 No environment reads here — cases parameterize through arguments only
 (tests/test_env_latch.py walks this package)."""
 
@@ -43,7 +67,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Tuple
 
 from .bc import (BCTable, FREE_SLIP, convective_outflow,
-                 dirichlet_inflow, free_slip, no_slip)
+                 dirichlet_inflow, free_slip, no_slip, periodic)
 from .config import SimConfig
 
 
@@ -71,6 +95,153 @@ def channel_table(u_in: float, profile: str = "uniform") -> BCTable:
     side walls."""
     return BCTable(dirichlet_inflow(u_in, profile=profile),
                    convective_outflow(), free_slip(), free_slip())
+
+
+def periodic_table() -> BCTable:
+    """Doubly-periodic box (all four faces wrap)."""
+    return BCTable(periodic(), periodic(), periodic(), periodic())
+
+
+def periodic_channel_table() -> BCTable:
+    """Periodic in x, no-slip walls in y — the mixed table the
+    fftd+tridiag solve (and its bench arm) exercises."""
+    return BCTable(periodic(), periodic(), no_slip(), no_slip())
+
+
+def _periodic_sim(cfg: SimConfig, lvl: int, mesh, members: int):
+    """Shared driver dispatch for the obstacle-free periodic cases
+    (the build_cavity pattern: fleet > sharded > solo)."""
+    bc = periodic_table()
+    if members > 0:
+        from .fleet import FleetSim
+        return FleetSim(cfg, level=lvl, members=members, mesh=mesh,
+                        bc=bc)
+    if mesh is not None:
+        from .parallel.mesh import ShardedUniformSim
+        return ShardedUniformSim(cfg, mesh, level=lvl, bc=bc)
+    from .uniform import UniformSim
+    return UniformSim(cfg, level=lvl, bc=bc)
+
+
+def _install_vel(sim, members: int, vel_fn):
+    """Overwrite the zero-state velocity with ``vel_fn(m) ->
+    [2, Ny, Nx]`` (numpy), broadcast/stacked over fleet slots."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    g = sim.grid
+    if members > 0:
+        v = np.stack([vel_fn(m) for m in range(members)])
+    else:
+        v = vel_fn(0)
+    sim.state = sim.state._replace(
+        vel=jnp.asarray(v, dtype=g.dtype))
+
+
+def build_tgv_periodic(level: Optional[int] = None, nu: float = 1e-3,
+                       u0: float = 1.0, dtype: str = "float32",
+                       mesh=None, members: int = 0, cfl: float = 0.4):
+    """Doubly-periodic Taylor-Green vortex on the unit box:
+    u = u0 sin(kx) cos(ky), v = -u0 cos(kx) sin(ky), k = 2pi.
+
+    The nonlinear term of this field is a pure gradient (absorbed by
+    the pressure), so the exact solution is self-similar decay —
+    KE(t) = KE(0) * exp(-4 nu k^2 t) — and the discrete IC sampled at
+    cell centers is divergence-free under the centered divergence
+    (the du/dx and dv/dy terms cancel mode-wise). Validation anchor
+    for the periodic BC + fftd stack (tests/test_cases.py)."""
+    lvl = 4 if level is None else level
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=1, level_start=0,
+                    extent=1.0, dtype=dtype, nu=nu, cfl=cfl,
+                    poisson_tol=1e-4, poisson_tol_rel=1e-3)
+    sim = _periodic_sim(cfg, lvl, mesh, members)
+
+    import numpy as np
+    x, y = sim.grid.cell_centers()
+    k = 2.0 * np.pi / cfg.extent
+    u = u0 * np.sin(k * x) * np.cos(k * y)
+    v = -u0 * np.cos(k * x) * np.sin(k * y)
+    _install_vel(sim, members, lambda m: np.stack([u, v]))
+    sim.case = "tgv_periodic"
+    return sim
+
+
+def build_shear_layer(level: Optional[int] = None, nu: float = 2e-4,
+                      rho: float = 30.0, delta: float = 0.05,
+                      u0: float = 1.0, dtype: str = "float32",
+                      mesh=None, members: int = 0, cfl: float = 0.4):
+    """Doubly-periodic double shear layer (Bell-Colella-Glaz 1989):
+    two tanh layers of width ~1/rho at y = 1/4 and y = 3/4, kicked by
+    a delta*sin(2pi x) vertical velocity that rolls each layer up
+    into the classic vortex pair."""
+    lvl = 4 if level is None else level
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=1, level_start=0,
+                    extent=1.0, dtype=dtype, nu=nu, cfl=cfl,
+                    poisson_tol=1e-4, poisson_tol_rel=1e-3)
+    sim = _periodic_sim(cfg, lvl, mesh, members)
+
+    import numpy as np
+    x, y = sim.grid.cell_centers()
+    L = cfg.extent
+    u = u0 * np.where(y <= 0.5 * L,
+                      np.tanh(rho * (y / L - 0.25)),
+                      np.tanh(rho * (0.75 - y / L)))
+    v = delta * u0 * np.sin(2.0 * np.pi * x / L)
+    _install_vel(sim, members, lambda m: np.stack([u, v]))
+    sim.case = "shear_layer"
+    return sim
+
+
+def build_turb2d(level: Optional[int] = None, nu: float = 1e-4,
+                 seed: int = 0, k0: float = 6.0, urms: float = 1.0,
+                 dtype: str = "float32", mesh=None, members: int = 0,
+                 cfl: float = 0.4):
+    """Seeded decaying 2D turbulence on the doubly-periodic unit box.
+
+    IC synthesis is host-side numpy (deterministic per seed, no
+    device RNG): a random-phase streamfunction with energy spectrum
+    E(k) ~ k / (1 + (k/k0)^4), inverse-FFT'd to the grid, then
+    differenced CENTRALLY to velocity (u = D_y psi, v = -D_x psi) so
+    the discrete centered divergence vanishes identically, and scaled
+    to rms speed ``urms``. Fleet members draw seed + slot index — one
+    member-batched fleet is a turbulence ensemble."""
+    lvl = 4 if level is None else level
+    cfg = SimConfig(bpdx=1, bpdy=1, level_max=1, level_start=0,
+                    extent=1.0, dtype=dtype, nu=nu, cfl=cfl,
+                    poisson_tol=1e-4, poisson_tol_rel=1e-3)
+    sim = _periodic_sim(cfg, lvl, mesh, members)
+
+    import numpy as np
+    g = sim.grid
+    ny, nx, h = g.ny, g.nx, g.h
+
+    def vel_for(m: int):
+        rng = np.random.default_rng(seed + m)
+        kx = np.fft.fftfreq(nx, d=1.0 / nx)
+        ky = np.fft.fftfreq(ny, d=1.0 / ny)
+        KX, KY = np.meshgrid(kx, ky, indexing="xy")
+        kk = np.sqrt(KX ** 2 + KY ** 2)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            # E(k) ~ k/(1+(k/k0)^4); psi-hat amplitude
+            # ~ sqrt(E(k)/k)/k (vorticity = k^2 psi-hat)
+            amp = np.where(
+                kk > 0,
+                np.sqrt(kk / (1.0 + (kk / k0) ** 4)) / (kk ** 1.5),
+                0.0)
+        phase = np.exp(2j * np.pi * rng.random((ny, nx)))
+        psi = np.fft.ifft2(amp * phase).real
+        # centered differences on the wrap: discretely div-free
+        u = (np.roll(psi, -1, axis=0) - np.roll(psi, 1, axis=0)) \
+            / (2.0 * h)
+        v = -(np.roll(psi, -1, axis=1) - np.roll(psi, 1, axis=1)) \
+            / (2.0 * h)
+        rms = np.sqrt(np.mean(u ** 2 + v ** 2))
+        s = urms / rms if rms > 0 else 1.0
+        return np.stack([u * s, v * s])
+
+    _install_vel(sim, members, vel_for)
+    sim.case = "turb2d"
+    return sim
 
 
 def build_cavity(level: Optional[int] = None, re: float = 100.0,
@@ -161,6 +332,15 @@ CASES: Tuple[CaseSpec, ...] = (
     CaseSpec("cylinder",
              "towed cylinder in the free-slip box (legacy validation)",
              build_cylinder, default_level=5),
+    CaseSpec("tgv_periodic",
+             "doubly-periodic Taylor-Green vortex (analytic KE decay)",
+             build_tgv_periodic, default_level=4, fleet_ok=True),
+    CaseSpec("shear_layer",
+             "doubly-periodic double shear layer roll-up (BCG 1989)",
+             build_shear_layer, default_level=4, fleet_ok=True),
+    CaseSpec("turb2d",
+             "seeded decaying 2D turbulence, doubly-periodic",
+             build_turb2d, default_level=4, fleet_ok=True),
 )
 
 REGISTRY = {c.name: c for c in CASES}

@@ -86,7 +86,7 @@ from .ops.stencil import (
     heun_substage,
     laplacian5_neumann,
 )
-from .poisson import bicgstab, mg_solve, project_correct
+from .poisson import bicgstab, fft_diag_solve, mg_solve, project_correct
 from .uniform import FlowState, UniformGrid, pad_vector, taylor_green_state
 
 
@@ -305,9 +305,22 @@ class FleetSim:
         converged-member freeze semantics — extra cycles the loop runs
         for the slowest member are bit-exact identity for converged
         ones (poisson.mg_solve member_axis); exact solves keep Krylov
-        exactly like the solo path."""
+        exactly like the solo path. Under ``fftd`` (ISSUE 20) the B
+        member systems batch through ONE set of transforms — the mode
+        axis is embarrassingly parallel — and every member reports
+        iters == 1, so the converged-member freeze contract is
+        trivially inert: there are no extra sweeps a frozen member
+        could diverge under (tests/test_fleet.py pins members == solo
+        bit-tight)."""
         g = self.grid
         cfg = self.cfg
+        if g.solver_mode == "fftd":
+            return fft_diag_solve(
+                g.laplacian, rhs, g._fft_plan,
+                tol=0.0 if exact else cfg.poisson_tol,
+                tol_rel=0.0 if exact else cfg.poisson_tol_rel,
+                member_axis=True,
+            )
         if g.solver_mode == "fas" and not exact:
             return mg_solve(
                 g.laplacian, rhs, g.mg,
@@ -428,7 +441,8 @@ class FleetSim:
             res.x, state.pres, vel, h, dt,
             spmd_safe=g.spmd_safe, mean_axes=(-2, -1),
             tier=corr_tier,
-            remove_mean=g.bc.all_neumann, grad_signs=g._psigns)
+            remove_mean=g.bc.all_neumann, grad_signs=g._psigns,
+            periodic=g._paxes)
         if active is not None:
             # freeze dead slots: state, diag and clock all read the
             # UNSTEPPED values (bit-exact slot preservation under

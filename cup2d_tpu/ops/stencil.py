@@ -358,20 +358,42 @@ def pressure_gradient_update_fused(p: jnp.ndarray, h, dt,
 # Signs/coefficients are derived once per table by bc.pressure_signs /
 # bc.divergence_coeffs; they are Python floats, so each table traces
 # its own executable (tables are static per driver).
+#
+# Periodic directions (ISSUE 20): the per-axis flags ``px`` / ``py``
+# (bc.periodic_axes) switch the shifts ALONG that axis to wrap (roll)
+# shifts, and the corresponding edge signs/coefficients come in as 0
+# (bc.pressure_signs / bc.divergence_coeffs) — no edge correction, the
+# wrapped interior cell IS the neighbor. Every shift here is
+# axis-aligned, so the wrap/zero choice is per shift, not per array.
 # ---------------------------------------------------------------------------
+
+def _shift_bc(p: jnp.ndarray, dy: int, dx: int, px: bool, py: bool,
+              spmd_safe: bool = False) -> jnp.ndarray:
+    """Axis-aligned unit shift honoring periodic axes: wrap (roll)
+    along a periodic axis, zero-ghost (_zshift) otherwise. roll lowers
+    to two slices + a concatenate — GSPMD shards it correctly (it is
+    the same pattern as the spmd_safe slice-then-pad form), so no
+    sharded variant is needed."""
+    if (dx != 0 and px) or (dy != 0 and py):
+        return jnp.roll(p, shift=(-dy, -dx), axis=(-2, -1))
+    return _zshift(p, dy, dx, spmd_safe)
+
 
 def laplacian5_bc(p: jnp.ndarray, sx_lo: float, sx_hi: float,
                   sy_lo: float, sy_hi: float,
-                  spmd_safe: bool = False) -> jnp.ndarray:
+                  spmd_safe: bool = False,
+                  px: bool = False, py: bool = False) -> jnp.ndarray:
     """Undivided 5-point Laplacian with per-face pressure-ghost signs
-    (+1 Neumann ghost = edge, -1 Dirichlet ghost = -edge). All-(+1)
+    (+1 Neumann ghost = edge, -1 Dirichlet ghost = -edge, 0 periodic —
+    with the matching wrap shift via ``px``/``py``). All-(+1)
     reproduces ``laplacian5_neumann``. The wall diagonal becomes
     -4 + sum(adjacent face signs) in [-6, -2] — never 0, so the
-    Jacobi smoother diagonal stays invertible at every level."""
+    Jacobi smoother diagonal stays invertible at every level
+    (periodic rows keep the full interior -4 diagonal)."""
     ny, nx = p.shape[-2], p.shape[-1]
     ex = _edge_ones(nx, p.dtype, lo=sx_lo, hi=sx_hi)
     ey = _edge_ones(ny, p.dtype, lo=sy_lo, hi=sy_hi)
-    zs = lambda dy, dx: _zshift(p, dy, dx, spmd_safe)
+    zs = lambda dy, dx: _shift_bc(p, dy, dx, px, py, spmd_safe)
     return (
         zs(0, 1) + zs(0, -1) + zs(1, 0) + zs(-1, 0)
         + p * ((ey[:, None] + ex[None, :]) - 4.0)
@@ -380,42 +402,47 @@ def laplacian5_bc(p: jnp.ndarray, sx_lo: float, sx_hi: float,
 
 def divergence_bc(v: jnp.ndarray, cx_lo: float, cx_hi: float,
                   cy_lo: float, cy_hi: float,
-                  spmd_safe: bool = False) -> jnp.ndarray:
+                  spmd_safe: bool = False,
+                  px: bool = False, py: bool = False) -> jnp.ndarray:
     """Undivided central divergence with per-face edge coefficients on
     the wall-NORMAL component (bc.divergence_coeffs): mirror and
     2*uw-edge ghosts keep the free-slip (+1 lo, -1 hi) pattern,
-    extrapolated outflow ghosts (ghost = edge) flip it. Prescribed
-    nonzero wall-normal velocities additionally contribute the
-    state-independent bc.divergence_affine_bc constant — added by the
-    caller, NOT here, so this stays linear in ``v`` (the fused RHS
-    applies it once, not per div() call)."""
+    extrapolated outflow ghosts (ghost = edge) flip it, periodic wraps
+    (coefficient 0, roll shift). Prescribed nonzero wall-normal
+    velocities additionally contribute the state-independent
+    bc.divergence_affine_bc constant — added by the caller, NOT here,
+    so this stays linear in ``v`` (the fused RHS applies it once, not
+    per div() call)."""
     u = v[..., 0, :, :]
     w = v[..., 1, :, :]
     ny, nx = u.shape[-2], u.shape[-1]
     gx = _edge_ones(nx, v.dtype, lo=cx_lo, hi=cx_hi)
     gy = _edge_ones(ny, v.dtype, lo=cy_lo, hi=cy_hi)
+    su = lambda dy, dx: _shift_bc(u, dy, dx, px, py, spmd_safe)
+    sw = lambda dy, dx: _shift_bc(w, dy, dx, px, py, spmd_safe)
     return (
-        _zshift(u, 0, 1, spmd_safe) - _zshift(u, 0, -1, spmd_safe)
-        + u * gx[None, :]
-        + _zshift(w, 1, 0, spmd_safe) - _zshift(w, -1, 0, spmd_safe)
-        + w * gy[:, None]
+        su(0, 1) - su(0, -1) + u * gx[None, :]
+        + sw(1, 0) - sw(-1, 0) + w * gy[:, None]
     )
 
 
 def pressure_gradient_update_bc(p: jnp.ndarray, h, dt,
                                 sx_lo: float, sx_hi: float,
                                 sy_lo: float, sy_hi: float,
-                                spmd_safe: bool = False) -> jnp.ndarray:
+                                spmd_safe: bool = False,
+                                px: bool = False,
+                                py: bool = False) -> jnp.ndarray:
     """Per-face-sign generalization of
     ``pressure_gradient_update_fused``: the undivided central gradient's
     edge coefficient is -s at the low wall and +s at the high wall
     (Neumann s=+1 reproduces the legacy (-1, +1) one-sided form;
-    Dirichlet s=-1 differences against the reflected ghost -edge)."""
+    Dirichlet s=-1 differences against the reflected ghost -edge;
+    periodic s=0 differences against the wrapped neighbor)."""
     ny, nx = p.shape[-2], p.shape[-1]
     gx = _edge_ones(nx, p.dtype, lo=-sx_lo, hi=sx_hi)
     gy = _edge_ones(ny, p.dtype, lo=-sy_lo, hi=sy_hi)
     pfac = -0.5 * dt * h
-    zs = lambda dy, dx: _zshift(p, dy, dx, spmd_safe)
+    zs = lambda dy, dx: _shift_bc(p, dy, dx, px, py, spmd_safe)
     dpx = (zs(0, 1) - zs(0, -1)) + p * gx[None, :]
     dpy = (zs(1, 0) - zs(-1, 0)) + p * gy[:, None]
     return pfac * jnp.stack([dpx, dpy], axis=-3)

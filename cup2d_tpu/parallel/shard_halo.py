@@ -676,6 +676,14 @@ def fused_advect_heun_sharded(vel, h, nu, dt, mesh: Mesh, *, bc=None,
 
     if bc is None or bc.is_free_slip:
         bc = BCTable()
+    # capability gate (names face/kind/token): periodic ('pd') tables
+    # refuse here — the halo exchange is a 3-wide NEIGHBOR ppermute
+    # with zero-filled boundary shards, and a wrap ghost would need a
+    # ring permute plus a wrap-aware strip pipeline neither kernel
+    # has. The same x-split is why CUP2D_POIS=fftd refuses
+    # attach_mesh: it would shard the FFT transform axis (periodic x)
+    # or the tridiagonal scan axis (periodic y). Run sharded periodic
+    # cases under the XLA tier with bicgstab/fas.
     pk.kernel_supports(bc)
     lead = vel.shape[:-3]
     L = pk._flatten_lead(lead)

@@ -98,12 +98,41 @@ class MultigridPreconditioner:
                  cycle_dtype=None, spmd_safe: bool = False,
                  mesh=None, overlap_levels: int = 1,
                  edge_signs=None, leg_dtype=None,
-                 smoother: str = "xla"):
+                 smoother: str = "xla",
+                 periodic=(False, False)):
         self.shapes = []
         self.nu1 = nu1
         self.nu2 = nu2
         self.omega = omega
         self.spmd_safe = spmd_safe
+        # periodic (px, py) — bc.periodic_axes (ISSUE 20): the cycle's
+        # operator uses wrap (roll) shifts along periodic axes at EVERY
+        # level (periodicity persists under 2x coarsening) and the
+        # matching edge signs come in as 0 through edge_signs, so the
+        # Jacobi diagonal keeps the interior -4 on periodic rows.
+        self.periodic = (bool(periodic[0]), bool(periodic[1]))
+        if any(self.periodic):
+            if edge_signs is None:
+                raise ValueError(
+                    "MultigridPreconditioner: periodic axes need the "
+                    "BC table's edge_signs (bc.pressure_signs — the "
+                    "periodic entries are 0); the legacy all-Neumann "
+                    "default would paint wall corrections over wrap "
+                    "rows")
+            if smoother == "strip":
+                # PR-16 refusal pattern: name the face/kind/token. The
+                # fused strip pipeline synthesizes ghosts from edge
+                # lines in-VMEM and has NO wrap form — silently running
+                # its Neumann edge corrections over periodic rows would
+                # smooth a different operator than the cycle corrects.
+                faces = [n for n, p in zip(("x_lo/x_hi", "y_lo/y_hi"),
+                                           self.periodic) if p]
+                raise ValueError(
+                    f"strip smoother does not support periodic faces "
+                    f"({' and '.join(faces)}: kind='periodic', token "
+                    f"'pd'): the fused sweep pipeline has no wrap-"
+                    "ghost variant — use smoother='xla' (or drop "
+                    "CUP2D_PALLAS) for periodic tables")
         # edge_signs: the BC table's per-face pressure-ghost signs
         # (sx_lo, sx_hi, sy_lo, sy_hi) from bc.pressure_signs — the
         # cycle's operator and Jacobi diagonal carry the same per-face
@@ -193,8 +222,9 @@ class MultigridPreconditioner:
         if self.edge_signs is not None:
             from .ops.stencil import laplacian5_bc
             sx_lo, sx_hi, sy_lo, sy_hi = self.edge_signs
+            px, py = self.periodic
             return laplacian5_bc(p, sx_lo, sx_hi, sy_lo, sy_hi,
-                                 self.spmd_safe)
+                                 self.spmd_safe, px, py)
         from .ops.stencil import laplacian5_neumann
         return laplacian5_neumann(p, self.spmd_safe)
 
@@ -844,6 +874,202 @@ def mg_solve(
 
 
 # ---------------------------------------------------------------------------
+# FFT-diagonalized DIRECT solve (ISSUE 20, CUP2D_POIS=fftd)
+#
+# A periodic direction's wrap second difference is diagonalized by the
+# real FFT into per-mode eigenvalues lam(k) = 2 cos(2 pi k / n) - 2
+# (the structural template is arXiv:2106.03583's FFT-accelerated
+# multi-block solver). Both directions periodic -> pointwise spectral
+# divide; one periodic -> an independent tridiagonal system per mode
+# along the wall axis, whose Neumann/Dirichlet rows come from the BC
+# table's pressure signs. Either way the per-step V-cycle train
+# collapses into ONE direct solve.
+# ---------------------------------------------------------------------------
+
+class FFTDiagPlan:
+    """Host-precomputed plan for the FFT-diagonalized direct Poisson
+    solve of the undivided per-face Laplacian (bc.py periodic kind).
+
+    * ``px and py`` (fully-periodic box): 2D real FFT, pointwise
+      divide by lam_y(m) + lam_x(k), inverse FFT. The (0, 0) nullspace
+      mode is pinned to zero, so the returned solution is exactly
+      mean-free (the projection's mean removal is then a no-op).
+    * one periodic direction: real FFT along it, then one TRIDIAGONAL
+      system per mode along the other (wall) axis — unit off-diagonals
+      and diagonal lam(k) - 2 + wall sign at the edge rows, solved by
+      the Thomas algorithm as two length-n first-order scans batched
+      over all modes and fleet members. The elimination coefficients
+      depend only on the STATIC diagonal, so they are precomputed here
+      in f64 numpy and baked as two [n, nmodes] device constants; the
+      per-solve work is the two complex recurrences plus the
+      transforms. The py-only case runs as the TRANSPOSED px-only
+      problem (the 5-point operator is symmetric under transposing the
+      grid), so one kernel serves both orientations.
+
+    Nullspace (periodic channel): the k=0 mode of all-Neumann walls is
+    the singular 1D Neumann Laplacian. Its RHS is mean-removed and the
+    first row pinned to x[0] = 0; the singular matrix's columns sum to
+    zero, so the pinned solve satisfies the original system EXACTLY
+    for a mean-free RHS — no residual leaks into the reported Linf.
+    Dirichlet walls (outflow faces) are non-singular and skip the pin.
+
+    Sharding: the transform and the tridiagonal scan are whole-array
+    sequential along their axes — there is no shard_map form, and the
+    mesh's x-split always shards one of the two (periodic x: the
+    transform axis; periodic y only: the scan axis).
+    ``UniformGrid.attach_mesh`` refuses the fftd latch outright (see
+    also parallel/shard_halo.py); sharded periodic cases run under
+    bicgstab/fas, whose wrap stencils GSPMD partitions correctly.
+    """
+
+    def __init__(self, ny: int, nx: int, dtype, px: bool, py: bool,
+                 edge_signs):
+        if not (px or py):
+            raise ValueError(
+                "FFTDiagPlan needs at least one periodic direction "
+                "(got px=False, py=False): with walls on all four "
+                "faces there is nothing to diagonalize — use "
+                "bicgstab/mg_solve")
+        self.ny, self.nx = ny, nx
+        self.px, self.py = px, py
+        self.dtype = jnp.dtype(dtype)
+        sx_lo, sx_hi, sy_lo, sy_hi = edge_signs
+        if px and py:
+            lx = 2.0 * np.cos(
+                2.0 * np.pi * np.arange(nx // 2 + 1) / nx) - 2.0
+            ly = 2.0 * np.cos(2.0 * np.pi * np.arange(ny) / ny) - 2.0
+            lam = ly[:, None] + lx[None, :]
+            mask = lam < -1e-12
+            ilam = np.where(mask, 1.0 / np.where(mask, lam, 1.0), 0.0)
+            self.ilam = jnp.asarray(ilam, self.dtype)
+            self.pin = True     # the zeroed (0,0) mode IS the pin
+            return
+        # single periodic direction: transform length n_t, tridiagonal
+        # system length n_s with the wall axis's signs
+        if px:
+            n_t, n_s = nx, ny
+            s_lo, s_hi = sy_lo, sy_hi
+        else:
+            n_t, n_s = ny, nx
+            s_lo, s_hi = sx_lo, sx_hi
+        nk = n_t // 2 + 1
+        lam = 2.0 * np.cos(2.0 * np.pi * np.arange(nk) / n_t) - 2.0
+        d = np.tile(lam[None, :], (n_s, 1)) - 2.0
+        d[0, :] += s_lo    # lint: allow[leading-dim] -- host numpy precompute, fixed [n_s, nk] matrix rows, never batched
+        d[-1, :] += s_hi   # lint: allow[leading-dim] -- host numpy precompute, fixed [n_s, nk] matrix rows, never batched
+        c = np.ones((n_s, nk))
+        c[-1, :] = 0.0     # lint: allow[leading-dim] -- host numpy precompute: no superdiagonal on the last row
+        self.pin = (s_lo == 1.0) and (s_hi == 1.0)
+        if self.pin:
+            # singular k=0 all-Neumann mode: row 0 -> identity
+            d[0, 0] = 1.0  # lint: allow[leading-dim] -- host numpy precompute of the k=0 nullspace pin
+            c[0, 0] = 0.0  # lint: allow[leading-dim] -- host numpy precompute of the k=0 nullspace pin
+        # Thomas forward elimination on the static matrix (unit
+        # subdiagonal): denom_j = d_j - cp_{j-1}, cp_j = c_j / denom_j
+        denom = np.empty((n_s, nk))
+        cp = np.empty((n_s, nk))
+        denom[0] = d[0]
+        cp[0] = c[0] / denom[0]
+        for j in range(1, n_s):
+            denom[j] = d[j] - cp[j - 1]
+            cp[j] = c[j] / denom[j]
+        self.cp = jnp.asarray(cp, self.dtype)
+        self.inv_denom = jnp.asarray(1.0 / denom, self.dtype)
+
+    def solve(self, b: jnp.ndarray) -> jnp.ndarray:
+        """Direct solve lap(x) = b (undivided per-face operator).
+        Leading axes (the fleet's member batch) ride the same
+        transforms — the mode axis is embarrassingly parallel."""
+        if self.px and self.py:
+            F = jnp.fft.rfft2(b)
+            x = jnp.fft.irfft2(F * self.ilam, s=(self.ny, self.nx))
+            return x.astype(b.dtype)
+        swap = not self.px        # py-only: transposed px-only problem
+        if swap:
+            b = jnp.swapaxes(b, -1, -2)
+        n_t = b.shape[-1]
+        bh = jnp.fft.rfft(b, axis=-1)          # [..., n_s, nk]
+        if self.pin:
+            # mean-free RHS for the singular k=0 mode, then pin row 0
+            col0 = bh[..., :, 0]
+            col0 = col0 - jnp.mean(col0, axis=-1, keepdims=True)
+            bh = bh.at[..., :, 0].set(col0)
+            bh = bh.at[..., 0, 0].set(0.0)
+        bt = jnp.moveaxis(bh, -2, 0)           # [n_s, ..., nk]
+
+        def fwd(dp_prev, xs):
+            bj, idj = xs
+            dp = (bj - dp_prev) * idj
+            return dp, dp
+
+        _, dps = jax.lax.scan(fwd, jnp.zeros_like(bt[0]),
+                              (bt, self.inv_denom))
+
+        def bwd(x_next, xs):
+            dpj, cpj = xs
+            xj = dpj - cpj * x_next
+            return xj, xj
+
+        _, xt = jax.lax.scan(bwd, jnp.zeros_like(bt[0]),
+                             (dps, self.cp), reverse=True)
+        x = jnp.fft.irfft(jnp.moveaxis(xt, 0, -2), n=n_t, axis=-1)
+        if swap:
+            x = jnp.swapaxes(x, -1, -2)
+        return x.astype(b.dtype)
+
+
+def fft_diag_solve(
+    A: Callable[[jnp.ndarray], jnp.ndarray],
+    b: jnp.ndarray,
+    plan: "FFTDiagPlan",
+    tol: float = 1e-3,
+    tol_rel: float = 1e-2,
+    member_axis: bool = False,
+) -> BiCGSTABResult:
+    """One-shot FFT-diagonalized direct solve with the SAME result/
+    stall/telemetry contract as ``bicgstab``/``mg_solve``, so drivers,
+    health verdicts and ``poisson_mode`` attribution read it
+    unchanged: ``x`` from :meth:`FFTDiagPlan.solve`, ``residual`` the
+    TRUE Linf residual of that x, ``converged`` against the shared
+    criterion Linf(r) <= max(tol, tol_rel * Linf(b)), ``iters`` = 1
+    unconditionally. A tol-0 "exact" request reports the direct
+    solve's precision floor through the benign ``stalled`` bit —
+    exactly how bicgstab's stall detector classifies its own tol-0
+    exits (resilience.health_verdict treats it as benign).
+
+    ``member_axis``: the fleet's B independent systems batch through
+    ONE transform (the mode axis is embarrassingly parallel); every
+    member reports iters == 1, so the converged-member freeze contract
+    of the iterative solvers is trivially inert — there are no extra
+    sweeps a frozen member could diverge under (tests/test_fleet.py).
+    """
+    from . import tracing
+    tracing.note_component("poisson.fft_diag_solve")
+    dt_ = b.dtype
+    if member_axis:
+        raxes = tuple(range(1, b.ndim))
+
+        def linf(a_):
+            return jnp.max(jnp.abs(a_), axis=raxes)
+    else:
+        def linf(a_):
+            return jnp.max(jnp.abs(a_))
+
+    x = plan.solve(b)
+    residual = linf(b - A(x))
+    target = jnp.maximum(jnp.asarray(tol, dt_), tol_rel * linf(b))
+    converged = residual <= target
+    return BiCGSTABResult(
+        x=x,
+        iters=jnp.ones_like(converged, dtype=jnp.int32)
+        if member_axis else jnp.asarray(1, jnp.int32),
+        residual=residual,
+        converged=converged,
+        stalled=~converged,
+    )
+
+
+# ---------------------------------------------------------------------------
 # Forest-native FAS hierarchy (the composite forest's own refinement
 # levels as the multigrid levels)
 # ---------------------------------------------------------------------------
@@ -1020,7 +1246,7 @@ class ForestFASCycle:
 
 def project_correct(x, pres_old, vel, h, dt, *, spmd_safe=False,
                     mean_axes=None, tier="xla", remove_mean=True,
-                    grad_signs=None):
+                    grad_signs=None, periodic=None):
     """Post-solve projection epilogue shared by the uniform and fleet
     drivers: ``pres = (x - mean x) + pres_old - mean pres_old`` and
     ``vel += -dt/(2h) * grad_neumann(pres) / h^2``.
@@ -1048,6 +1274,14 @@ def project_correct(x, pres_old, vel, h, dt, *, spmd_safe=False,
     mx/mp is the identity, and gs=(1,1,1,1) reproduces the hard-coded
     edge constants).
 
+    ``periodic`` is the table's (px, py) axis flags (bc.periodic_axes,
+    ISSUE 20): the gradient's shifts wrap along periodic axes. Only
+    the XLA branch carries it — the fused correction kernel has no
+    wrap form, and periodic tables can never arm the fused tier
+    (ops/pallas_kernels.kernel_supports refuses the pd token), so the
+    kernel branch is statically unreachable for them; the guard here
+    keeps that invariant structural rather than assumed.
+
     Returns (vel, pres).
     """
     from .ops.stencil import (pressure_gradient_update_bc,
@@ -1063,7 +1297,8 @@ def project_correct(x, pres_old, vel, h, dt, *, spmd_safe=False,
     else:
         mx = jnp.mean(x, axis=mean_axes, keepdims=True)
         mp = jnp.mean(pres_old, axis=mean_axes, keepdims=True)
-    if tier != "xla" and x.dtype == jnp.float32:
+    px, py = periodic if periodic is not None else (False, False)
+    if tier != "xla" and x.dtype == jnp.float32 and not (px or py):
         from .ops.pallas_kernels import fused_correction
         lead = x.shape[:-2]
         ny, nx = x.shape[-2:]
@@ -1092,5 +1327,6 @@ def project_correct(x, pres_old, vel, h, dt, *, spmd_safe=False,
     else:
         sx_lo, sx_hi, sy_lo, sy_hi = grad_signs
         dv = pressure_gradient_update_bc(pres, h, dt_b, sx_lo, sx_hi,
-                                         sy_lo, sy_hi, spmd_safe)
+                                         sy_lo, sy_hi, spmd_safe,
+                                         px, py)
     return vel + dv * ih2, pres

@@ -352,7 +352,7 @@ class HostCounters:
 # always present; fields that do not apply to a path (AMR shape on a
 # uniform run, comm volume on a single device, counters when disabled)
 # are null — consumers key on names, never on presence.
-METRICS_SCHEMA_VERSION = 11
+METRICS_SCHEMA_VERSION = 12
 METRICS_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     # solver health + timestep state (the step's existing diag pull).
@@ -372,7 +372,12 @@ METRICS_KEYS = (
     # forest "bicgstab+jacobi | bicgstab+twolevel | bicgstab+fft |
     # fas+forest | fas-f+forest" (PR 13 — forest-native FAS as the
     # full solver; there precond_cycles == poisson_iters, one mg_solve
-    # cycle per outer iteration, vs the Krylov arms' 2 M-applies/iter)
+    # cycle per outer iteration, vs the Krylov arms' 2 M-applies/iter).
+    # Schema v12 (ISSUE 20) adds the uniform-family DIRECT tokens:
+    # "fftd" (doubly-periodic pure-spectral solve) and "fftd+tridiag"
+    # (one periodic axis FFT-diagonalized, per-mode Thomas solves on
+    # the wall axis) — both report poisson_iters == 1 by contract and
+    # precond_cycles == 0 (no hierarchy runs)
     "poisson_mode", "precond_cycles",
     # kernel-tier attribution (schema v6, PR 9): the ACTIVE advection
     # kernel tier latch (drivers' .kernel_tier — xla | pallas-fused |
@@ -394,10 +399,13 @@ METRICS_KEYS = (
     "smoother_tier",
     # boundary-condition attribution (schema v8, ISSUE 12): the
     # driver's compact per-face BCTable token string (.bc_table — e.g.
-    # "fs,fs,fs,fs" legacy box, "ns,ns,ns,ns(1,0)" lid-driven cavity)
-    # and the case-registry tag (.case — cavity|channel|cylinder, null
-    # outside -case runs), so a record says WHICH physics scenario it
-    # measured, like poisson_mode says which solve path
+    # "fs,fs,fs,fs" legacy box, "ns,ns,ns,ns(1,0)" lid-driven cavity;
+    # schema v12 adds the "pd" face token — "pd,pd,pd,pd" for the
+    # doubly-periodic turbulence catalog, "pd,pd,ns,ns" periodic
+    # channels) and the case-registry tag (.case — cavity|channel|
+    # cylinder|tgv_periodic|shear_layer|turb2d, null outside -case
+    # runs), so a record says WHICH physics scenario it measured, like
+    # poisson_mode says which solve path
     "bc_table", "case",
     # fused on-device physics invariants (watchdog inputs)
     "energy", "div_linf",

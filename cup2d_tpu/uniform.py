@@ -29,6 +29,7 @@ from .bc import (
     divergence_affine_bc,
     divergence_coeffs,
     pad_vector_bc,
+    periodic_axes,
     pressure_signs,
 )
 from .config import SimConfig
@@ -44,10 +45,12 @@ from .ops.stencil import (
     vorticity,
 )
 from .poisson import (
+    FFTDiagPlan,
     MultigridPreconditioner,
     apply_block_precond,
     bicgstab,
     block_precond_matrix,
+    fft_diag_solve,
     mg_solve,
     project_correct,
 )
@@ -207,14 +210,18 @@ class UniformGrid:
         # valid but inert here so one latched env serves a mixed
         # process. A typo must fail loudly, not silently measure the
         # default on both A/B arms.
+        # "fftd" (ISSUE 20): FFT-diagonalized DIRECT solve — rides
+        # THIS sanctioned read, no new latch site (the graftlint
+        # assertion in tests/test_analysis.py pins that).
         pois = os.environ.get("CUP2D_POIS", "")
         if pois not in ("", "structured", "tables", "fft",
-                        "fas", "fas-f"):
+                        "fas", "fas-f", "fftd"):
             raise ValueError(
                 f"CUP2D_POIS={pois!r}: expected "
-                "structured|tables|fft|fas|fas-f")
-        self.solver_mode = "fas" if pois in ("fas", "fas-f") \
-            else "bicgstab"
+                "structured|tables|fft|fas|fas-f|fftd")
+        self.solver_mode = ("fftd" if pois == "fftd"
+                            else "fas" if pois in ("fas", "fas-f")
+                            else "bicgstab")
         self.fas_fmg = pois == "fas-f"
         self.level = lvl
         self.nx = cfg.bpdx * cfg.bs << lvl
@@ -228,11 +235,32 @@ class UniformGrid:
             self._psigns = None
             self._dcoeffs = None
             self._div_affine = None
+            self._paxes = (False, False)
         else:
             self._psigns = pressure_signs(self.bc)
             self._dcoeffs = divergence_coeffs(self.bc)
             self._div_affine = divergence_affine_bc(
                 self.bc, self.ny, self.nx, self.dtype)
+            # periodic axis flags (ISSUE 20): wrap shifts in the
+            # operator/divergence/gradient stencils
+            self._paxes = periodic_axes(self.bc)
+        # FFT-diagonalized direct solve (CUP2D_POIS=fftd): the plan's
+        # transforms/eigenvalues/tridiagonal elimination coefficients
+        # are host-precomputed once per grid. Needs >= 1 periodic
+        # direction — a wall-only box has nothing to diagonalize.
+        if self.solver_mode == "fftd":
+            px, py = self._paxes
+            if not (px or py):
+                raise ValueError(
+                    f"CUP2D_POIS=fftd needs at least one periodic "
+                    f"direction, got BCTable ({self.bc.token}): the "
+                    "FFT diagonalizes a periodic axis's second "
+                    "difference — run wall-only boxes under "
+                    "bicgstab/fas")
+            self._fft_plan = FFTDiagPlan(
+                self.ny, self.nx, self.dtype, px, py, self._psigns)
+        else:
+            self._fft_plan = None
         # multigrid V-cycle preconditioner: O(1) Krylov iterations in N,
         # where the reference's single-level block-Jacobi (kept above for
         # the oracle/AMR paths) degrades linearly in N_1d/BS.
@@ -270,7 +298,8 @@ class UniformGrid:
                          else None),
             edge_signs=self._psigns,
             leg_dtype=self._fas_leg_dtype,
-            smoother=self._mg_smoother)
+            smoother=self._mg_smoother,
+            periodic=self._paxes)
         # f64 dot-product accumulation when fields are f32 AND x64 is
         # available (the Krylov scalars are precision-critical, SURVEY.md §7
         # hard part 5). Without x64, XLA's tree reduction keeps f32 error at
@@ -316,8 +345,9 @@ class UniformGrid:
         if self._psigns is None:
             return laplacian5_neumann(p, self.spmd_safe)
         sx_lo, sx_hi, sy_lo, sy_hi = self._psigns
+        px, py = self._paxes
         return laplacian5_bc(p, sx_lo, sx_hi, sy_lo, sy_hi,
-                             self.spmd_safe)
+                             self.spmd_safe, px, py)
 
     # -- BC-aware ghost paint + divergence, shared with fleet.py's
     # inlined member-batched step so the table dispatch cannot
@@ -346,12 +376,13 @@ class UniformGrid:
             return divergence_rhs_fused(vel, udef, chi, h, dt,
                                         self.spmd_safe)
         fac = 0.5 * h / dt
-        b = fac * divergence_bc(vel, *self._dcoeffs, self.spmd_safe)
+        b = fac * divergence_bc(vel, *self._dcoeffs, self.spmd_safe,
+                                *self._paxes)
         if self._div_affine is not None:
             b = b + fac * self._div_affine
         if chi is not None:
             b = b - (fac * chi) * divergence_bc(
-                udef, *self._dcoeffs, self.spmd_safe)
+                udef, *self._dcoeffs, self.spmd_safe, *self._paxes)
         return b
 
     def precond(self, r: jnp.ndarray) -> jnp.ndarray:
@@ -360,7 +391,12 @@ class UniformGrid:
     @property
     def poisson_mode(self) -> str:
         """The active solve-path latch, for the telemetry stream
-        (schema v4 ``poisson_mode``)."""
+        (schema v4 ``poisson_mode``; v12 adds the fftd vocabulary):
+        ``fftd`` = pure spectral divide (both directions periodic),
+        ``fftd+tridiag`` = per-mode Thomas systems (one periodic)."""
+        if self.solver_mode == "fftd":
+            return "fftd" if (self._paxes[0] and self._paxes[1]) \
+                else "fftd+tridiag"
         if self.solver_mode == "fas":
             return "fas-f" if self.fas_fmg else "fas"
         return "bicgstab+mg" if self.cfg.precond else "bicgstab"
@@ -411,6 +447,20 @@ class UniformGrid:
         (shard_halo.overlap_jacobi_sweeps). The default Krylov
         preconditioner cycles stay on the GSPMD form whose
         sharded==single equality is already pinned."""
+        if self.solver_mode == "fftd":
+            # documented refusal (ISSUE 20): the FFT transform and the
+            # per-mode tridiagonal scan are whole-array sequential
+            # along their axes — the mesh's x-split always shards one
+            # of them (periodic x: the transform axis; periodic y
+            # only: the scan axis), and neither has a shard_map form
+            # (parallel/shard_halo.py). Sharded periodic cases run
+            # under bicgstab/fas, whose wrap stencils GSPMD partitions
+            # correctly.
+            raise ValueError(
+                "CUP2D_POIS=fftd cannot attach a device mesh: the "
+                "x-split shards the FFT transform axis (periodic x) "
+                "or the tridiagonal scan axis (periodic y) — run "
+                "sharded periodic cases under bicgstab/fas")
         self._mesh = mesh
         if self.solver_mode == "fas":
             self.mg = MultigridPreconditioner(
@@ -431,6 +481,17 @@ class UniformGrid:
         the solver's stall detector at whatever the actual precision
         floor is, with a tight refresh cadence so the exit is prompt."""
         cfg = self.cfg
+        if self.solver_mode == "fftd":
+            # direct solve (CUP2D_POIS=fftd): exact to the precision
+            # floor in ONE application — the tol-0 "exact" startup
+            # request needs no escalation path, it simply reports the
+            # floor through the benign stalled bit exactly like
+            # bicgstab's tol-0 stall exit.
+            return fft_diag_solve(
+                self.laplacian, rhs, self._fft_plan,
+                tol=0.0 if exact else cfg.poisson_tol,
+                tol_rel=0.0 if exact else cfg.poisson_tol_rel,
+            )
         if self.solver_mode == "fas" and not exact:
             # production solves as pure MG cycles (CUP2D_POIS=fas):
             # 1 A-apply + 1 V-cycle per iteration vs Krylov's 2 + 2.
@@ -512,7 +573,8 @@ class UniformGrid:
         vel, pres = project_correct(
             res.x, pres_old, vel, h, dt,
             spmd_safe=self.spmd_safe, tier=corr_tier,
-            remove_mean=self.bc.all_neumann, grad_signs=self._psigns)
+            remove_mean=self.bc.all_neumann, grad_signs=self._psigns,
+            periodic=self._paxes)
         return vel, pres, res, div_linf
 
     def precond_cycles(self, res, exact):
@@ -524,6 +586,9 @@ class UniformGrid:
         cycles). A host-derived count would desynchronize from the
         device iters under the lagged verdict, so this rides the same
         diag pull as the iters themselves."""
+        if self.solver_mode == "fftd":
+            # direct solve: no hierarchy cycles at all
+            return jnp.zeros_like(res.iters)
         if self.solver_mode == "fas" and not exact:
             return res.iters
         if self.cfg.precond:

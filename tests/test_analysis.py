@@ -456,3 +456,18 @@ def test_cli_list_rules():
     for rule in ("env-latch", "host-sync", "donation-safety",
                  "retrace-hazard", "leading-dim"):
         assert rule in proc.stdout
+
+
+def test_fftd_rides_the_sanctioned_pois_latch():
+    # ISSUE 20: "fftd" is a VALUE of the CUP2D_POIS latch, not a new
+    # read site — the policy table must still sanction exactly the two
+    # historical constructor latches, and the package walk must stay
+    # clean (an fftd-motivated os.environ read anywhere else would
+    # surface here as an unsanctioned-site finding).
+    from cup2d_tpu.analysis.policy import ENV_LATCH_SITES
+    sites = sorted(site for site, vars_ in ENV_LATCH_SITES.items()
+                   if "CUP2D_POIS" in vars_)
+    assert sites == [("amr.py", "AMRSim.__init__"),
+                     ("uniform.py", "UniformGrid.__init__")]
+    report = lint_package(only=["env-latch"])
+    assert report.clean, [str(f) for f in report.findings]

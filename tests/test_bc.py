@@ -33,8 +33,10 @@ import pytest
 from cup2d_tpu.bc import (BCTable, FREE_SLIP, convective_outflow,
                           dirichlet_inflow, divergence_affine_bc,
                           divergence_coeffs, free_slip, no_slip,
-                          pad_vector_bc, pressure_signs)
-from cup2d_tpu.cases import cavity_table, channel_table, make_sim
+                          pad_vector_bc, periodic, periodic_axes,
+                          pressure_signs)
+from cup2d_tpu.cases import (cavity_table, channel_table, make_sim,
+                             periodic_channel_table, periodic_table)
 from cup2d_tpu.config import SimConfig
 from cup2d_tpu.ops.stencil import (divergence_bc, divergence_freeslip,
                                    laplacian5_bc, laplacian5_neumann,
@@ -81,7 +83,12 @@ def test_table_tokens_flags_and_validation():
     assert cavity_table() != cavity_table(lid_u=2.0)
 
     with pytest.raises(ValueError, match="unknown kind"):
-        BCTable(x_lo=free_slip()._replace(kind="periodic")).validate()
+        BCTable(x_lo=free_slip()._replace(kind="bogus")).validate()
+    # periodic is a valid kind since ISSUE 20 — but only PAIRED
+    with pytest.raises(ValueError, match="paired"):
+        BCTable(x_lo=periodic()).validate()
+    with pytest.raises(ValueError, match="paired"):
+        BCTable(y_hi=periodic()).validate()
     with pytest.raises(ValueError, match="uniform|parabolic"):
         dirichlet_inflow(1.0, profile="plug")
 
@@ -187,6 +194,104 @@ def test_pad_convective_outflow_local_speed():
     for k in range(g):
         np.testing.assert_allclose(out0[:, :, nx + g + k],
                                    out0[:, :, nx + g - 1])
+
+
+# ---------------------------------------------------------------------------
+# periodic faces (ISSUE 20): wrap paint + derived coefficients + wrapped
+# operator stencils, each against a hand-rolled torus reference
+# ---------------------------------------------------------------------------
+
+def test_periodic_table_tokens_and_coefficients():
+    per = periodic_table()
+    assert per.token == "pd,pd,pd,pd"
+    assert not per.is_free_slip
+    # the operator is still all-Neumann-singular on the torus: the
+    # mean-removal contract stays on
+    assert per.all_neumann
+    assert pressure_signs(per) == (0.0, 0.0, 0.0, 0.0)
+    assert divergence_coeffs(per) == (0.0, 0.0, 0.0, 0.0)
+    assert periodic_axes(per) == (True, True)
+    assert divergence_affine_bc(per, 6, 8, jnp.float64) is None
+
+    chan = periodic_channel_table()
+    assert chan.token == "pd,pd,ns,ns"
+    assert periodic_axes(chan) == (True, False)
+    assert pressure_signs(chan) == (0.0, 0.0, 1.0, 1.0)
+    assert periodic_axes(FREE_SLIP) == (False, False)
+
+
+def test_pad_periodic_wrap_vs_roll_reference():
+    """All-periodic box: the padded array IS the torus — every ghost
+    cell (corners included) equals np.pad(..., mode='wrap')."""
+    g, ny, nx = 2, 5, 7
+    v = _rand((2, ny, nx), 7)
+    out = np.asarray(pad_vector_bc(v, g, periodic_table(), 0.1))
+    ref = np.pad(np.asarray(v), ((0, 0), (g, g), (g, g)), mode="wrap")
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_pad_periodic_mixed_channel_corners():
+    """Periodic-x + no-slip-y: y ghosts paint first on interior
+    columns, then the x wrap copies FULL rows — so a corner ghost is
+    the wrapped image of the y-painted wall ghost (y-then-x
+    composition, same order as the wall-only corner rule)."""
+    g, ny, nx = 2, 5, 6
+    v = _rand((2, ny, nx), 8)
+    out = np.asarray(pad_vector_bc(v, g, periodic_channel_table(), 0.1))
+    vn = np.asarray(v)
+
+    # reference: y no-slip paint on the unpadded columns...
+    ye = np.zeros((2, ny + 2 * g, nx))
+    ye[:, g:-g, :] = vn
+    for k in range(g):
+        ye[:, k, :] = -vn[:, 0, :]
+        ye[:, ny + g + k, :] = -vn[:, -1, :]
+    # ...then the x wrap of the painted rows (torus in x only)
+    ref = np.pad(ye, ((0, 0), (0, 0), (g, g)), mode="wrap")
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_periodic_operators_vs_torus_reference():
+    """laplacian5_bc / divergence_bc / pressure_gradient_update_bc
+    with periodic axes equal the hand-rolled np.roll torus stencils
+    (signs/coefficients are 0 on periodic faces — no edge terms)."""
+    ny, nx = 6, 8
+    p = np.asarray(_rand((ny, nx), 9))
+    v = _rand((2, ny, nx), 10)
+    h, dt = 0.1, 0.03
+
+    def roll(a, dy, dx):
+        return np.roll(a, shift=(-dy, -dx), axis=(-2, -1))
+
+    # fully periodic
+    got = np.asarray(laplacian5_bc(jnp.asarray(p), 0.0, 0.0, 0.0, 0.0,
+                                   px=True, py=True))
+    ref = (roll(p, 0, 1) + roll(p, 0, -1) + roll(p, 1, 0)
+           + roll(p, -1, 0) - 4.0 * p)
+    np.testing.assert_allclose(got, ref, rtol=1e-13)
+
+    u, w = np.asarray(v[0]), np.asarray(v[1])
+    got = np.asarray(divergence_bc(v, 0.0, 0.0, 0.0, 0.0,
+                                   px=True, py=True))
+    ref = (roll(u, 0, 1) - roll(u, 0, -1)) + (roll(w, 1, 0)
+                                              - roll(w, -1, 0))
+    np.testing.assert_allclose(got, ref, rtol=1e-13)
+
+    got = np.asarray(pressure_gradient_update_bc(
+        jnp.asarray(p), h, dt, 0.0, 0.0, 0.0, 0.0, px=True, py=True))
+    pfac = -0.5 * dt * h
+    ref = pfac * np.stack([roll(p, 0, 1) - roll(p, 0, -1),
+                           roll(p, 1, 0) - roll(p, -1, 0)])
+    np.testing.assert_allclose(got, ref, rtol=1e-13)
+
+    # mixed channel: wrap in x, no-slip walls in y (Neumann pressure)
+    got = np.asarray(laplacian5_bc(jnp.asarray(p), 0.0, 0.0, 1.0, 1.0,
+                                   px=True, py=False))
+    pe = np.pad(p, ((1, 1), (0, 0)), mode="edge")   # Neumann y ghosts
+    pe = np.pad(pe, ((0, 0), (1, 1)), mode="wrap")  # periodic x
+    ref = (pe[1:-1, 2:] + pe[1:-1, :-2] + pe[2:, 1:-1]
+           + pe[:-2, 1:-1] - 4.0 * p)
+    np.testing.assert_allclose(got, ref, rtol=1e-13)
 
 
 # ---------------------------------------------------------------------------

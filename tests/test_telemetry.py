@@ -79,8 +79,13 @@ def _amr_sim():
 # compile/memory ledger, PR 18); v11 the smoother-tier attribution
 # (smoother_tier — the pressure hierarchy's sweep-chain latch, xla |
 # strip | strip+bf16 with "+bf16" suffixing whatever base the shape
-# gate left armed, ISSUE 19).
-_SCHEMA_V11_KEYS = (
+# gate left armed, ISSUE 19); v12 a VALUE-vocabulary rev, no key
+# moved (ISSUE 20): poisson_mode gains the uniform-family direct
+# tokens "fftd" / "fftd+tridiag" (FFT-diagonalized per-mode solves,
+# poisson_iters == 1 by contract, precond_cycles == 0) and bc_table
+# gains the "pd" periodic face token ("pd,pd,pd,pd" turbulence box,
+# "pd,pd,ns,ns" periodic channel).
+_SCHEMA_V12_KEYS = (
     "schema", "step", "t", "dt", "wall_ms",
     "umax", "dt_next",
     "poisson_iters", "poisson_residual",
@@ -104,15 +109,15 @@ _SCHEMA_V11_KEYS = (
 )
 
 
-def test_metrics_schema_v11_key_set_pinned():
+def test_metrics_schema_v12_key_set_pinned():
     from cup2d_tpu.profiling import METRICS_SCHEMA_VERSION
-    assert METRICS_SCHEMA_VERSION == 11
-    assert METRICS_KEYS == _SCHEMA_V11_KEYS
+    assert METRICS_SCHEMA_VERSION == 12
+    assert METRICS_KEYS == _SCHEMA_V12_KEYS
 
 
 @pytest.mark.slow   # ~17 s; duplicative tier-1 coverage: the frozen key
 #                     SET is pinned as a literal tuple in
-#                     test_metrics_schema_v11_key_set_pinned and the
+#                     test_metrics_schema_v12_key_set_pinned and the
 #                     uniform producer stream (every record, key-exact)
 #                     in test_cli_metrics_stream_and_post_report; the
 #                     AMR/bench records drilled here ride the identical
